@@ -1,0 +1,25 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability set of early
+DeepLearning4J (reference: /root/reference, deeplearning4j-parent
+0.0.3.4-SNAPSHOT).  The compute path is functional JAX compiled by XLA onto
+the MXU; parallelism is SPMD over `jax.sharding.Mesh` axes with XLA
+collectives riding ICI/DCN; host-side runtime pieces (data decode, vocab
+builds, prefetch) have native C++ implementations with pure-Python fallbacks.
+
+Top-level namespaces (mirroring the reference's layer map, SURVEY.md §1):
+
+- ``ops``       — L0 tensor/math substrate (the ND4J/JBLAS contract, TPU-native)
+- ``nn``        — L1 core NN runtime: configs, layers, MultiLayerNetwork
+- ``optimize``  — L2 optimization engine: transforms, solvers, listeners
+- ``datasets``  — L3 data layer: DataSet, iterators, fetchers
+- ``eval``      — L4 evaluation: confusion-matrix metrics
+- ``plot``      — L4 visualization: t-SNE, renderers
+- ``clustering``— L4 clustering: k-means, kd/vp/quad trees
+- ``parallel``  — L5-7 distributed: mesh, collectives, routers, checkpointing
+- ``text``      — L8 NLP: tokenization, vocab, embeddings models
+- ``models``    — flagship model zoo (MLP/DBN, LeNet, LSTM, transformer)
+- ``utils``     — shared host-side utilities
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""L0 — tensor/math substrate (TPU-native ND4J-contract replacement).
+
+See SURVEY.md §2.1: the reference delegates all tensor math to external
+ND4J/JBLAS (JNI → Fortran BLAS).  Here the substrate is JAX/XLA: jnp arrays
+are the INDArray equivalent (functional, not in-place), and these modules
+provide the named contract surface the upper layers consume.
+"""
+
+from . import activations, convolution, dtypes, linalg, losses, rng, sampling
+from .dtypes import DtypePolicy, get_policy, set_policy
+from .losses import LossFunction
+from .rng import RngStream
+
+__all__ = [
+    "activations", "convolution", "dtypes", "linalg", "losses", "rng",
+    "sampling", "DtypePolicy", "get_policy", "set_policy", "LossFunction",
+    "RngStream",
+]

@@ -1,0 +1,84 @@
+"""Activation functions and their derivatives.
+
+TPU-native equivalent of the ND4J ``Activations`` factory consumed at e.g.
+``nn/layers/BaseLayer.java:163`` and ``nn/layers/OutputLayer.java:129`` of the
+reference.  Functions are elementwise jnp ops XLA fuses into surrounding
+matmuls; ``softmax`` operates row-wise like the reference's
+``Activations.softMaxRows``.
+
+``apply_derivative`` mirrors ``ActivationFunction.applyDerivative``
+(used by the hand-written backprop in ``MultiLayerNetwork.java:618,654``).
+The real gradient path here is JAX autodiff; the explicit derivatives exist
+for API parity and for tests that pin down the math.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Activation = Callable[[jnp.ndarray], jnp.ndarray]
+
+_REGISTRY: dict[str, Activation] = {}
+_DERIVATIVES: dict[str, Activation] = {}
+
+
+def register(name: str, fn: Activation, deriv: Activation | None = None):
+    _REGISTRY[name] = fn
+    if deriv is not None:
+        _DERIVATIVES[name] = deriv
+    return fn
+
+
+def get(name: str) -> Activation:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def apply(name: str, x: jnp.ndarray) -> jnp.ndarray:
+    return get(name)(x)
+
+
+def apply_derivative(name: str, x: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise derivative f'(x).
+
+    For ``softmax`` this returns the diagonal approximation y*(1-y) the
+    reference uses inside its delta chain (the full Jacobian is handled by
+    autodiff in the real training path).
+    """
+    if name in _DERIVATIVES:
+        return _DERIVATIVES[name](x)
+    fn = get(name)
+    # Fallback: elementwise derivative via vmapped grad.
+    flat = x.reshape(-1)
+    d = jax.vmap(jax.grad(lambda v: fn(v.reshape(1))[0]))(flat)
+    return d.reshape(x.shape)
+
+
+def softmax(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.softmax(x, axis=-1)
+
+
+register("sigmoid", jax.nn.sigmoid, lambda x: jax.nn.sigmoid(x) * (1 - jax.nn.sigmoid(x)))
+register("tanh", jnp.tanh, lambda x: 1 - jnp.tanh(x) ** 2)
+register("relu", jax.nn.relu, lambda x: (x > 0).astype(x.dtype))
+register("leakyrelu", lambda x: jax.nn.leaky_relu(x, 0.01),
+         lambda x: jnp.where(x > 0, 1.0, 0.01).astype(x.dtype))
+register("linear", lambda x: x, lambda x: jnp.ones_like(x))
+register("identity", lambda x: x, lambda x: jnp.ones_like(x))
+register("exp", jnp.exp, jnp.exp)
+register("softsign", jax.nn.soft_sign, lambda x: 1.0 / (1.0 + jnp.abs(x)) ** 2)
+register("softplus", jax.nn.softplus, jax.nn.sigmoid)
+register("hardtanh", lambda x: jnp.clip(x, -1.0, 1.0),
+         lambda x: ((x > -1.0) & (x < 1.0)).astype(x.dtype))
+register("gelu", jax.nn.gelu)
+register("softmax", softmax, lambda x: softmax(x) * (1 - softmax(x)))
+register("logsoftmax", lambda x: jax.nn.log_softmax(x, axis=-1))

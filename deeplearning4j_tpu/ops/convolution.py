@@ -1,0 +1,69 @@
+"""Convolution and pooling primitives.
+
+TPU-native equivalent of ND4J ``Convolution.conv2d`` and
+``Transforms.maxPool`` as consumed by the reference's
+``nn/layers/convolution/ConvolutionDownSampleLayer.java:40-53``.  Built on
+``lax.conv_general_dilated`` / ``lax.reduce_window`` so XLA tiles them onto
+the MXU / VPU; layout is NCHW to match the reference's
+(examples, channels, rows, cols) convention, and both ops are fully
+differentiable (the reference's conv backward is a stub —
+``ConvolutionDownSampleLayer.java:105-112`` — ours is real autodiff).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DN = ("NCHW", "OIHW", "NCHW")
+
+
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, stride: Sequence[int] = (1, 1),
+           padding: str = "VALID", precision=None) -> jnp.ndarray:
+    """2-D convolution. x: (N,C,H,W); w: (O,I,kH,kW) -> (N,O,H',W').
+
+    Note: like XLA (and unlike the reference's FFT-based ``conv2d`` full-mode),
+    this is cross-correlation with VALID/SAME padding — the deep-learning
+    convention the reference's layer actually relies on.  ``precision=None``
+    uses the backend default (fast MXU path on TPU); pass
+    ``lax.Precision.HIGHEST`` for full-f32 accumulation.
+    """
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        dimension_numbers=_DN, precision=precision,
+        preferred_element_type=jnp.float32)
+
+
+def max_pool(x: jnp.ndarray, window: Sequence[int], stride: Sequence[int] | None = None,
+             padding: str = "VALID") -> jnp.ndarray:
+    """Max pooling over the trailing two (spatial) dims of an NCHW tensor."""
+    stride = tuple(stride) if stride is not None else tuple(window)
+    dims = (1, 1) + tuple(window)
+    strides = (1, 1) + stride
+    return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+
+
+def avg_pool(x: jnp.ndarray, window: Sequence[int], stride: Sequence[int] | None = None,
+             padding: str = "VALID") -> jnp.ndarray:
+    stride = tuple(stride) if stride is not None else tuple(window)
+    dims = (1, 1) + tuple(window)
+    strides = (1, 1) + stride
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    if padding == "VALID":
+        return summed / (window[0] * window[1])
+    counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strides, padding)
+    return summed / counts
+
+
+def im2col(x: jnp.ndarray, kh: int, kw: int, stride: Sequence[int] = (1, 1),
+           padding: str = "VALID") -> jnp.ndarray:
+    """Extract sliding patches: (N,C,H,W) -> (N, C*kh*kw, L) with L output
+    positions.  Parity helper for the reference's im2col-based kernels; on TPU
+    prefer conv2d directly (XLA already lowers to MXU-tiled convolution)."""
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), tuple(stride), padding, dimension_numbers=_DN)
+    n, ckk, h, w = patches.shape
+    return patches.reshape(n, ckk, h * w)

@@ -1,0 +1,48 @@
+"""Dtype policy for the TPU runtime.
+
+The reference forces float32 for tests (``pom.xml:198`` ``-Ddtype=float``) and
+threads a global float/double switch through ND4J's ``DataBuffer``
+(``InMemoryLookupTable.java:207,257``).  On TPU the idiomatic split is between
+a *parameter* dtype (float32 by default) and a *compute* dtype (bfloat16 on
+the MXU when enabled), so the policy carries both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DtypePolicy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+
+    def cast_compute(self, x):
+        return x.astype(self.compute_dtype) if hasattr(x, "astype") else x
+
+    def cast_param(self, x):
+        return x.astype(self.param_dtype) if hasattr(x, "astype") else x
+
+
+_POLICY = DtypePolicy()
+
+
+def get_policy() -> DtypePolicy:
+    return _POLICY
+
+
+def set_policy(param_dtype=None, compute_dtype=None) -> DtypePolicy:
+    """Set the global dtype policy (mirrors the reference's -Ddtype switch)."""
+    global _POLICY
+    _POLICY = DtypePolicy(
+        param_dtype=jnp.dtype(param_dtype) if param_dtype is not None else _POLICY.param_dtype,
+        compute_dtype=jnp.dtype(compute_dtype) if compute_dtype is not None else _POLICY.compute_dtype,
+    )
+    return _POLICY
+
+
+def bf16_compute() -> DtypePolicy:
+    """Enable bfloat16 MXU compute with float32 params (mixed precision)."""
+    return set_policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16)

@@ -1,0 +1,54 @@
+"""BLAS-contract parity ops.
+
+The reference consumes ``Nd4j.getBlasWrapper()`` for ``dot``, ``axpy``,
+``iamax`` (``MultiLayerNetwork.java:1062``,
+``InMemoryLookupTable.java:192,208``) and matrix multiply via
+``INDArray.mmul``.  On TPU these are jnp/lax compositions XLA lowers to MXU
+dot-generals; they exist as named functions so higher layers read like the
+contract they replace.  In-place BLAS semantics (axpy mutating y) become
+functional returns.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .dtypes import get_policy
+
+
+def gemm(a: jnp.ndarray, b: jnp.ndarray, precision=None) -> jnp.ndarray:
+    """Matrix multiply with the active compute-dtype policy (bf16 on MXU when
+    enabled), accumulating in float32.  ``precision=None`` takes the backend
+    default (fast MXU passes); ``lax.Precision.HIGHEST`` forces full f32."""
+    policy = get_policy()
+    return jnp.matmul(policy.cast_compute(a), policy.cast_compute(b),
+                      precision=precision,
+                      preferred_element_type=jnp.float32).astype(policy.param_dtype)
+
+
+mmul = gemm
+
+
+def dot(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.vdot(x, y)
+
+
+def axpy(alpha, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """y + alpha*x (functional form of BLAS axpy)."""
+    return y + alpha * x
+
+
+def iamax(x: jnp.ndarray) -> jnp.ndarray:
+    """Index of the max-|value| element (argmax over flattened input)."""
+    return jnp.argmax(jnp.abs(jnp.ravel(x)))
+
+
+def nrm2(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sqrt(jnp.sum(x * x))
+
+
+def to_flattened(arrays) -> jnp.ndarray:
+    """Concatenate raveled arrays — Nd4j.toFlattened, used for param vectors
+    (``MultiLayerNetwork.java:744-788`` params()/setParams)."""
+    return jnp.concatenate([jnp.ravel(a) for a in arrays])

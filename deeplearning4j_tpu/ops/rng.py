@@ -1,0 +1,52 @@
+"""Stateless RNG utilities.
+
+The reference threads a mutable ``RandomGenerator`` (commons-math Mersenne
+twister, wrapped in ``rng/SynchronizedRandomGenerator.java`` for thread
+safety) through configs (``nn/conf/NeuralNetConfiguration.java:64-68``).  On
+TPU, stateful RNG does not compose with jit/vmap/scan, so the substrate is
+JAX's counter-based threefry keys.  ``RngStream`` gives host-side code the
+ergonomic "one generator object" feel while staying purely functional
+underneath: every draw splits a fresh subkey.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class RngStream:
+    """Host-side convenience wrapper over a threefry key.
+
+    Inside jitted code always use explicit `jax.random` keys; this class is
+    for eager host orchestration (weight init, data shuffles) where the
+    reference used its synchronized Mersenne twister.
+    """
+
+    def __init__(self, seed_or_key):
+        if isinstance(seed_or_key, int):
+            self._key = jax.random.key(seed_or_key)
+        else:
+            self._key = seed_or_key
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def split(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return subs
+
+    def uniform(self, shape=(), minval=0.0, maxval=1.0, dtype=jnp.float32):
+        return jax.random.uniform(self.next_key(), shape, dtype, minval, maxval)
+
+    def normal(self, shape=(), dtype=jnp.float32):
+        return jax.random.normal(self.next_key(), shape, dtype)
+
+    def permutation(self, n: int):
+        return jax.random.permutation(self.next_key(), n)
+
+
+def key_for(seed: int | None, default: int = 123):
+    """Make a key from an optional seed (reference defaults its rng seed)."""
+    return jax.random.key(default if seed is None else seed)

@@ -1,0 +1,47 @@
+"""Stochastic sampling ops.
+
+TPU-native equivalent of ND4J ``Sampling.binomial`` (used by dropout /
+dropconnect at ``nn/multilayer/MultiLayerNetwork.java:468`` and by RBM Gibbs
+steps) and the distribution factories in
+``deeplearning4j-core/.../distributions/Distributions.java``.  All samplers
+are stateless: they take an explicit threefry key so they can live inside
+jit/scan (SURVEY.md §7 hard-part #1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def binomial(key, p: jnp.ndarray, n: int = 1) -> jnp.ndarray:
+    """Sample Binomial(n, p) elementwise. n=1 is the Bernoulli used by RBMs."""
+    if n == 1:
+        return jax.random.bernoulli(key, p).astype(p.dtype)
+    draws = jax.random.bernoulli(key, p[None, ...] * jnp.ones((n,) + p.shape, p.dtype))
+    return jnp.sum(draws, axis=0).astype(p.dtype)
+
+
+def gaussian(key, mean: jnp.ndarray, std=1.0) -> jnp.ndarray:
+    return mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+
+
+def dropout_mask(key, shape, rate: float, dtype=jnp.float32) -> jnp.ndarray:
+    """Inverted-scaling dropout mask: E[mask * x] == x.
+
+    The reference multiplies activations by an unscaled binomial mask
+    (``BaseLayer.java:139-146``); the TPU build uses the standard inverted
+    scaling so inference needs no rescale.
+    """
+    if rate <= 0.0:
+        return jnp.ones(shape, dtype)
+    keep = 1.0 - rate
+    return jax.random.bernoulli(key, keep, shape).astype(dtype) / keep
+
+
+def uniform(key, shape, lo: float, hi: float, dtype=jnp.float32) -> jnp.ndarray:
+    return jax.random.uniform(key, shape, dtype, lo, hi)
+
+
+def normal(key, shape, mean: float = 0.0, std: float = 1.0, dtype=jnp.float32) -> jnp.ndarray:
+    return mean + std * jax.random.normal(key, shape, dtype)

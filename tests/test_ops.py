@@ -1,0 +1,138 @@
+"""L0 ops parity tests vs NumPy (SURVEY.md §7 build order step 1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import activations, convolution, linalg, losses, sampling
+from deeplearning4j_tpu.ops.rng import RngStream
+
+
+class TestActivations:
+    def test_sigmoid_matches_numpy(self, rng_np):
+        x = rng_np.standard_normal((4, 5)).astype(np.float32)
+        got = activations.apply("sigmoid", jnp.asarray(x))
+        np.testing.assert_allclose(got, 1 / (1 + np.exp(-x)), rtol=1e-5)
+
+    def test_softmax_rows_sum_to_one(self, rng_np):
+        x = jnp.asarray(rng_np.standard_normal((3, 7)).astype(np.float32))
+        y = activations.apply("softmax", x)
+        np.testing.assert_allclose(np.sum(np.asarray(y), axis=-1), np.ones(3), rtol=1e-5)
+
+    @pytest.mark.parametrize("name", ["sigmoid", "tanh", "relu", "softplus",
+                                      "hardtanh", "leakyrelu", "linear", "softsign"])
+    def test_derivative_matches_autodiff(self, name, rng_np):
+        x = jnp.asarray(rng_np.standard_normal((11,)).astype(np.float32)) * 2
+        fn = activations.get(name)
+        want = jax.vmap(jax.grad(lambda v: fn(v[None])[0]))(x)
+        got = activations.apply_derivative(name, x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.get("nope")
+
+
+class TestLosses:
+    def test_mcxent_known_value(self):
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        out = jnp.array([[0.8, 0.2], [0.4, 0.6]])
+        want = -(np.log(0.8) + np.log(0.6)) / 2
+        np.testing.assert_allclose(losses.score("mcxent", labels, out), want, rtol=1e-5)
+
+    def test_mse_known_value(self):
+        labels = jnp.array([[1.0, 0.0]])
+        out = jnp.array([[0.0, 0.0]])
+        np.testing.assert_allclose(losses.score("mse", labels, out), 0.5, rtol=1e-6)
+
+    @pytest.mark.parametrize("name", [lf.value for lf in losses.LossFunction])
+    def test_all_losses_finite_and_differentiable(self, name, rng_np):
+        labels = jnp.asarray(np.eye(4, dtype=np.float32)[rng_np.integers(0, 4, 6)])
+        logits = jnp.asarray(rng_np.standard_normal((6, 4)).astype(np.float32))
+        out = jax.nn.softmax(logits)
+        val = losses.score(name, labels, out)
+        assert np.isfinite(float(val))
+        g = jax.grad(lambda o: losses.score(name, labels, jax.nn.softmax(o)))(logits)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_xent_penalizes_wrong_more(self):
+        labels = jnp.array([[1.0, 0.0]])
+        good = losses.score("xent", labels, jnp.array([[0.9, 0.1]]))
+        bad = losses.score("xent", labels, jnp.array([[0.1, 0.9]]))
+        assert float(bad) > float(good)
+
+
+class TestConvolution:
+    def test_conv2d_matches_naive(self, rng_np):
+        x = rng_np.standard_normal((2, 3, 6, 6)).astype(np.float32)
+        w = rng_np.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        got = np.asarray(convolution.conv2d(jnp.asarray(x), jnp.asarray(w),
+                                            precision=jax.lax.Precision.HIGHEST))
+        want = np.zeros((2, 4, 4, 4), np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(4):
+                    for j in range(4):
+                        want[n, o, i, j] = np.sum(x[n, :, i:i + 3, j:j + 3] * w[o])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_max_pool(self):
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        y = convolution.max_pool(x, (2, 2))
+        np.testing.assert_allclose(np.asarray(y)[0, 0], [[5, 7], [13, 15]])
+
+    def test_conv_is_differentiable(self, rng_np):
+        # The reference's conv backward is a stub; ours must be real.
+        x = jnp.asarray(rng_np.standard_normal((1, 2, 5, 5)).astype(np.float32))
+        w = jnp.asarray(rng_np.standard_normal((3, 2, 2, 2)).astype(np.float32))
+        g = jax.grad(lambda w_: jnp.sum(convolution.conv2d(x, w_) ** 2))(w)
+        assert g.shape == w.shape and np.all(np.isfinite(np.asarray(g)))
+
+    def test_im2col_shape(self, rng_np):
+        x = jnp.asarray(rng_np.standard_normal((2, 3, 5, 5)).astype(np.float32))
+        cols = convolution.im2col(x, 2, 2)
+        assert cols.shape == (2, 3 * 2 * 2, 16)
+
+
+class TestLinalg:
+    def test_gemm_vs_numpy(self, rng_np):
+        a = rng_np.standard_normal((3, 4)).astype(np.float32)
+        b = rng_np.standard_normal((4, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            linalg.gemm(jnp.asarray(a), jnp.asarray(b), precision=jax.lax.Precision.HIGHEST),
+            a @ b, rtol=1e-5)
+
+    def test_axpy_iamax_dot(self):
+        x = jnp.array([1.0, -5.0, 2.0])
+        y = jnp.array([1.0, 1.0, 1.0])
+        np.testing.assert_allclose(linalg.axpy(2.0, x, y), [3.0, -9.0, 5.0])
+        assert int(linalg.iamax(x)) == 1
+        np.testing.assert_allclose(linalg.dot(x, y), -2.0)
+
+    def test_to_flattened(self):
+        v = linalg.to_flattened([jnp.ones((2, 2)), jnp.zeros((3,))])
+        assert v.shape == (7,)
+
+
+class TestSampling:
+    def test_binomial_mean(self):
+        key = jax.random.key(0)
+        p = jnp.full((10000,), 0.3)
+        s = sampling.binomial(key, p)
+        assert abs(float(jnp.mean(s)) - 0.3) < 0.02
+        assert set(np.unique(np.asarray(s))) <= {0.0, 1.0}
+
+    def test_dropout_mask_preserves_expectation(self):
+        key = jax.random.key(1)
+        m = sampling.dropout_mask(key, (100000,), 0.5)
+        assert abs(float(jnp.mean(m)) - 1.0) < 0.02
+
+    def test_dropout_zero_rate_is_ones(self):
+        m = sampling.dropout_mask(jax.random.key(2), (5,), 0.0)
+        np.testing.assert_allclose(m, np.ones(5))
+
+    def test_rng_stream_reproducible(self):
+        a = RngStream(7).normal((3,))
+        b = RngStream(7).normal((3,))
+        np.testing.assert_allclose(a, b)
