@@ -1,0 +1,41 @@
+"""L3 — data layer (reference: ``deeplearning4j-core/.../datasets`` + ``base``).
+
+Host-side data pipeline: the ``DataSet`` container, the ``DataSetIterator``
+protocol with fetcher-backed and wrapper implementations, and dataset
+fetchers (Iris/MNIST/digits/CSV/LFW) with offline-first sourcing.
+"""
+
+from .dataset import DataSet
+from .fetchers import (
+    BaseDataFetcher,
+    CSVDataFetcher,
+    DigitsDataFetcher,
+    IrisDataFetcher,
+    LFWDataFetcher,
+    MnistDataFetcher,
+)
+from .iterator import (
+    BaseDatasetIterator,
+    CSVDataSetIterator,
+    DataSetIterator,
+    DigitsDataSetIterator,
+    IrisDataSetIterator,
+    ListDataSetIterator,
+    MnistDataSetIterator,
+    MovingWindowDataSetIterator,
+    MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)
+
+__all__ = [
+    "DataSet",
+    "BaseDataFetcher", "CSVDataFetcher", "DigitsDataFetcher",
+    "IrisDataFetcher", "LFWDataFetcher", "MnistDataFetcher",
+    "BaseDatasetIterator", "CSVDataSetIterator", "DataSetIterator",
+    "DigitsDataSetIterator", "IrisDataSetIterator", "ListDataSetIterator",
+    "MnistDataSetIterator", "MovingWindowDataSetIterator",
+    "MultipleEpochsIterator", "ReconstructionDataSetIterator",
+    "SamplingDataSetIterator", "TestDataSetIterator",
+]

@@ -1,0 +1,5 @@
+"""L4 — evaluation (reference: ``deeplearning4j-core/.../eval``)."""
+
+from .evaluation import ConfusionMatrix, Evaluation
+
+__all__ = ["ConfusionMatrix", "Evaluation"]

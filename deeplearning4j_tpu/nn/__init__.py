@@ -1,0 +1,23 @@
+"""L1 — core NN runtime: configs, layers, the MultiLayerNetwork container.
+
+TPU-native re-design of the reference's ``deeplearning4j-core/.../nn`` tree
+(SURVEY.md §1 L1).  Layers are pure ``init(rng) -> params`` /
+``apply(params, x, ...) -> y`` modules over jnp pytrees; the container jits
+whole train steps; autodiff replaces the hand-written delta chains.
+"""
+
+from .conf import (
+    ConfOverride,
+    LayerConfig,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from .multilayer import MultiLayerNetwork
+
+__all__ = [
+    "ConfOverride",
+    "LayerConfig",
+    "MultiLayerConfiguration",
+    "NeuralNetConfiguration",
+    "MultiLayerNetwork",
+]

@@ -1,0 +1,600 @@
+"""Functional layer modules with named param tables.
+
+TPU-native re-design of the reference's layer system (``nn/api/Layer.java:
+18-93`` contract, ``nn/layers/*`` impls, ``nn/params/*ParamInitializer`` and
+``nn/layers/factory/LayerFactories``).  Key differences by design:
+
+- layers are *stateless* descriptors: ``init(key) -> params`` returns a dict
+  pytree; ``activate(params, x)`` is pure.  The reference's mutable
+  ``Layer.setParams/getParam`` becomes explicit pytree threading, which is
+  what jit/grad/vmap need;
+- parameter names keep the reference's keys ("W", "b", "vb",
+  "convweights"/"convbias", "recurrentweights"/"decoderweights"/
+  "decoderbias") so param-table introspection and serde feel familiar;
+- backprop is `jax.grad` over the pure apply; the hand-written delta chains
+  (``MultiLayerNetwork.computeDeltas``) and the LSTM manual BPTT
+  (``models/classifiers/lstm/LSTM.java:63-140``) are not re-implemented —
+  autodiff subsumes them.  RBM contrastive divergence keeps explicit
+  sampling (CD-k is not the gradient of a tractable loss) under the
+  stateless threefry RNG;
+- ``merge`` (parameter averaging for distributed training,
+  ``Layer.java:merge``) is a pytree mean;
+- conv has forward AND backward (the reference's conv backward is a stub,
+  ``ConvolutionDownSampleLayer.java:105-112`` returns null).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import activations as act
+from ..ops import losses as losses_mod
+from ..ops.dtypes import get_policy
+from ..ops.losses import LossFunction
+from .conf import (
+    LayerKind,
+    NeuralNetConfiguration,
+    RBMHiddenUnit,
+    RBMVisibleUnit,
+)
+from .weights import init_from_conf
+
+Params = dict[str, jnp.ndarray]
+
+# Canonical param-table keys (nn/params/*ParamInitializer.java).
+W, B, VBIAS = "W", "b", "vb"
+CONV_W, CONV_B = "convweights", "convbias"
+REC_W, DEC_W, DEC_B = "recurrentweights", "decoderweights", "decoderbias"
+
+
+# --------------------------------------------------------------------------- utils
+
+def dropout_mask(key, shape, rate: float, dtype):
+    """Inverted-dropout mask (reference applies raw binomial masks;
+    inverted scaling keeps eval-time activations calibrated)."""
+    from ..ops.sampling import dropout_mask as _mask
+    return _mask(key, shape, rate, dtype)
+
+
+def merge_params(params_list: Sequence[Params]) -> Params:
+    """Parameter averaging (``Layer.merge``; used by iterative-reduce DP)."""
+    return jax.tree_util.tree_map(lambda *xs: sum(xs) / float(len(xs)), *params_list)
+
+
+def flatten_params(params: Params, order: Sequence[str]) -> jnp.ndarray:
+    """Flatten named params in deterministic key order
+    (``conf.getGradientList()`` idea; ``MultiLayerNetwork.params():744-788``)."""
+    return jnp.concatenate([params[k].reshape(-1) for k in order])
+
+
+def unflatten_params(flat: jnp.ndarray, template: Params, order: Sequence[str]) -> Params:
+    out, off = {}, 0
+    for k in order:
+        size = template[k].size
+        out[k] = flat[off:off + size].reshape(template[k].shape).astype(template[k].dtype)
+        off += size
+    return out
+
+
+# --------------------------------------------------------------------------- base
+
+class Layer:
+    """Descriptor + pure functions; subclasses define param_order/init/activate.
+
+    Contract parity with ``nn/api/Layer.java``: activate, preOutput
+    (pre_output), param table (init), merge (module-level merge_params),
+    transpose (on pretrain layers).
+    """
+
+    kind: LayerKind = LayerKind.DENSE
+    param_order: tuple[str, ...] = (W, B)
+
+    def __init__(self, conf: NeuralNetConfiguration):
+        self.conf = conf
+
+    # -- params ----------------------------------------------------------
+    def init(self, key) -> Params:
+        raise NotImplementedError
+
+    def n_params(self, params: Params) -> int:
+        return sum(params[k].size for k in self.param_order)
+
+    def flatten(self, params: Params) -> jnp.ndarray:
+        return flatten_params(params, self.param_order)
+
+    def unflatten(self, flat: jnp.ndarray, template: Params) -> Params:
+        return unflatten_params(flat, template, self.param_order)
+
+    # -- forward ---------------------------------------------------------
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None,
+                 train: bool = False) -> jnp.ndarray:
+        raise NotImplementedError
+
+    # -- shape bookkeeping ----------------------------------------------
+    def output_dim(self) -> int:
+        return self.conf.n_out
+
+
+class DenseLayer(Layer):
+    """``nn/layers/BaseLayer.java:31,130-171`` — f(xW + b) with dropout."""
+
+    kind = LayerKind.DENSE
+    param_order = (W, B)
+
+    def init(self, key) -> Params:
+        kw, _ = jax.random.split(key)
+        conf = self.conf
+        w = init_from_conf(kw, (conf.n_in, conf.n_out), conf)
+        b = jnp.zeros((conf.n_out,), get_policy().param_dtype)
+        return {W: w, B: b}
+
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        pol = get_policy()
+        z = pol.cast_compute(x) @ pol.cast_compute(params[W]) + params[B].astype(pol.compute_dtype)
+        return z
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        if train and self.conf.dropout > 0 and rng is not None:
+            x = x * dropout_mask(rng, x.shape, self.conf.dropout, x.dtype)
+        return act.apply(self.conf.activation, self.pre_output(params, x))
+
+
+class OutputLayer(DenseLayer):
+    """``nn/layers/OutputLayer.java`` — classifier/regression head.
+
+    The reference hand-codes per-loss weight gradients (``:93-154``); here the
+    loss is a differentiable function of (labels, activated output) and
+    training uses `jax.grad`.
+    """
+
+    kind = LayerKind.OUTPUT
+    param_order = (W, B)
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        return act.apply(self.conf.activation, self.pre_output(params, x))
+
+    def loss(self, params: Params, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+        out = self.activate(params, x)
+        l = losses_mod.score(self.conf.loss, labels, out)
+        if self.conf.use_regularization and self.conf.l2 > 0:
+            l = l + 0.5 * self.conf.l2 * jnp.sum(params[W] ** 2)
+        return l
+
+    def label_probabilities(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        return self.activate(params, x)
+
+
+# --------------------------------------------------------------------------- pretrain
+
+class BasePretrainLayer(Layer):
+    """``nn/layers/BasePretrainNetwork.java:26-144`` equivalent: adds visible
+    bias, reconstruction score, sampling SPI, and CD/denoising gradients."""
+
+    param_order = (W, B, VBIAS)
+
+    def init(self, key) -> Params:
+        kw, _ = jax.random.split(key)
+        conf = self.conf
+        pol = get_policy()
+        w = init_from_conf(kw, (conf.n_in, conf.n_out), conf)
+        return {
+            W: w,
+            B: jnp.zeros((conf.n_out,), pol.param_dtype),
+            VBIAS: jnp.zeros((conf.n_in,), pol.param_dtype),
+        }
+
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        pol = get_policy()
+        return pol.cast_compute(x) @ pol.cast_compute(params[W]) + params[B].astype(pol.compute_dtype)
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        if train and self.conf.dropout > 0 and rng is not None:
+            x = x * dropout_mask(rng, x.shape, self.conf.dropout, x.dtype)
+        return act.apply(self.conf.activation, self.pre_output(params, x))
+
+    def transpose(self) -> "BasePretrainLayer":
+        """``Layer.transpose()`` — decoder view (W^T, swapped biases)."""
+        conf = self.conf.replace(n_in=self.conf.n_out, n_out=self.conf.n_in)
+        return type(self)(conf)
+
+    # pretrain gradient SPI — subclasses return (score, grads)
+    def pretrain_value_and_grad(self, params: Params, x: jnp.ndarray, key):
+        raise NotImplementedError
+
+
+class AutoEncoder(BasePretrainLayer):
+    """Denoising autoencoder (``models/featuredetectors/autoencoder/
+    AutoEncoder.java:23,44-115``): corrupt input, encode with (W, b), decode
+    with (W^T, vb), reconstruction cross-entropy; gradient via autodiff."""
+
+    kind = LayerKind.AUTOENCODER
+
+    def encode(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        return act.apply(self.conf.activation, self.pre_output(params, x))
+
+    def decode(self, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+        pol = get_policy()
+        z = pol.cast_compute(h) @ pol.cast_compute(params[W]).T + params[VBIAS].astype(pol.compute_dtype)
+        return act.apply(self.conf.activation, z)
+
+    def reconstruct(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        return self.decode(params, self.encode(params, x))
+
+    def corrupt(self, key, x: jnp.ndarray) -> jnp.ndarray:
+        """Masking corruption at conf.corruption_level (``getCorruptedInput``)."""
+        keep = jax.random.bernoulli(key, 1.0 - self.conf.corruption_level, x.shape)
+        return x * keep.astype(x.dtype)
+
+    def pretrain_loss(self, params: Params, x: jnp.ndarray, key) -> jnp.ndarray:
+        corrupted = self.corrupt(key, x) if self.conf.corruption_level > 0 else x
+        recon = self.reconstruct(params, corrupted)
+        l = losses_mod.reconstruction_crossentropy(x, recon)
+        if self.conf.sparsity > 0 or self.conf.apply_sparsity:
+            h = self.encode(params, x)
+            l = l + jnp.mean((jnp.mean(h, axis=0) - self.conf.sparsity) ** 2)
+        if self.conf.use_regularization and self.conf.l2 > 0:
+            l = l + 0.5 * self.conf.l2 * jnp.sum(params[W] ** 2)
+        return l
+
+    def pretrain_value_and_grad(self, params: Params, x: jnp.ndarray, key):
+        return jax.value_and_grad(self.pretrain_loss)(params, x, key)
+
+
+class RBM(BasePretrainLayer):
+    """Restricted Boltzmann machine with CD-k.
+
+    Capability match of ``models/featuredetectors/rbm/RBM.java``: visible
+    units BINARY/GAUSSIAN/SOFTMAX/LINEAR, hidden RECTIFIED/BINARY/GAUSSIAN/
+    SOFTMAX (``:54-70``), k-step Gibbs chain gradient (``:95-160``), free
+    energy.  The Gibbs chain runs under ``lax.scan`` with threefry keys —
+    stateless-RNG threading replaces the shared mutable RNG.
+    """
+
+    kind = LayerKind.RBM
+
+    # -- conditionals ----------------------------------------------------
+    def prop_up(self, params: Params, v: jnp.ndarray) -> jnp.ndarray:
+        pre = self.pre_output(params, v)
+        hu = self.conf.hidden_unit
+        if hu == RBMHiddenUnit.BINARY:
+            return jax.nn.sigmoid(pre)
+        if hu == RBMHiddenUnit.GAUSSIAN:
+            return pre
+        if hu == RBMHiddenUnit.RECTIFIED:
+            return jax.nn.relu(pre)
+        if hu == RBMHiddenUnit.SOFTMAX:
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(hu)
+
+    def prop_down(self, params: Params, h: jnp.ndarray) -> jnp.ndarray:
+        pol = get_policy()
+        pre = pol.cast_compute(h) @ pol.cast_compute(params[W]).T + params[VBIAS].astype(pol.compute_dtype)
+        vu = self.conf.visible_unit
+        if vu == RBMVisibleUnit.BINARY:
+            return jax.nn.sigmoid(pre)
+        if vu in (RBMVisibleUnit.GAUSSIAN, RBMVisibleUnit.LINEAR):
+            return pre
+        if vu == RBMVisibleUnit.SOFTMAX:
+            return jax.nn.softmax(pre, axis=-1)
+        raise ValueError(vu)
+
+    def sample_hidden_given_visible(self, params: Params, v: jnp.ndarray, key):
+        mean = self.prop_up(params, v)
+        hu = self.conf.hidden_unit
+        if hu == RBMHiddenUnit.BINARY:
+            sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+        elif hu == RBMHiddenUnit.GAUSSIAN:
+            sample = mean + jax.random.normal(key, mean.shape, mean.dtype)
+        elif hu == RBMHiddenUnit.RECTIFIED:
+            # NReLU sampling: relu(pre + N(0, sigmoid(pre))) (reference follows
+            # Nair&Hinton; RBM.java rectified branch)
+            pre = self.pre_output(params, v)
+            noise = jax.random.normal(key, pre.shape, pre.dtype) * jnp.sqrt(jax.nn.sigmoid(pre))
+            sample = jax.nn.relu(pre + noise)
+        elif hu == RBMHiddenUnit.SOFTMAX:
+            idx = jax.random.categorical(key, jnp.log(mean + 1e-12), axis=-1)
+            sample = jax.nn.one_hot(idx, mean.shape[-1], dtype=mean.dtype)
+        else:
+            raise ValueError(hu)
+        return mean, sample
+
+    def sample_visible_given_hidden(self, params: Params, h: jnp.ndarray, key):
+        mean = self.prop_down(params, h)
+        vu = self.conf.visible_unit
+        if vu == RBMVisibleUnit.BINARY:
+            sample = jax.random.bernoulli(key, mean).astype(mean.dtype)
+        elif vu == RBMVisibleUnit.GAUSSIAN:
+            sample = mean + jax.random.normal(key, mean.shape, mean.dtype)
+        elif vu == RBMVisibleUnit.LINEAR:
+            sample = mean
+        elif vu == RBMVisibleUnit.SOFTMAX:
+            idx = jax.random.categorical(key, jnp.log(mean + 1e-12), axis=-1)
+            sample = jax.nn.one_hot(idx, mean.shape[-1], dtype=mean.dtype)
+        else:
+            raise ValueError(vu)
+        return mean, sample
+
+    def gibbs_hvh(self, params: Params, h: jnp.ndarray, key):
+        kv, kh = jax.random.split(key)
+        v_mean, v_sample = self.sample_visible_given_hidden(params, h, kv)
+        h_mean, h_sample = self.sample_hidden_given_visible(params, v_sample, kh)
+        return v_mean, v_sample, h_mean, h_sample
+
+    def free_energy(self, params: Params, v: jnp.ndarray) -> jnp.ndarray:
+        """F(v) = -v·vb - sum log(1+exp(xW+b)) (binary-binary form)."""
+        pre = self.pre_output(params, v)
+        vbias_term = v @ params[VBIAS]
+        hidden_term = jnp.sum(jax.nn.softplus(pre), axis=-1)
+        return -vbias_term - hidden_term
+
+    def pretrain_value_and_grad(self, params: Params, x: jnp.ndarray, key):
+        """CD-k gradient (positive phase − negative phase after k Gibbs steps).
+
+        Returns (score, grads) where score is reconstruction cross-entropy
+        (the reference's ``BasePretrainNetwork`` score) and grads is in
+        *descent* orientation (apply with gradient-descent updates).
+        """
+        conf = self.conf
+        k0, kchain = jax.random.split(key)
+        ph_mean, ph_sample = self.sample_hidden_given_visible(params, x, k0)
+
+        def body(carry, kk):
+            h = carry
+            v_mean, v_sample, h_mean, h_sample = self.gibbs_hvh(params, h, kk)
+            return h_sample, (v_mean, v_sample, h_mean)
+
+        keys = jax.random.split(kchain, max(conf.k, 1))
+        _, (v_means, v_samples, h_means) = jax.lax.scan(body, ph_sample, keys)
+        nv_mean, nv_sample, nh_mean = v_means[-1], v_samples[-1], h_means[-1]
+
+        n = x.shape[0]
+        # descent orientation: -(positive - negative)/n
+        w_grad = -(x.T @ ph_mean - nv_sample.T @ nh_mean) / n
+        hb_grad = -jnp.mean(ph_mean - nh_mean, axis=0)
+        vb_grad = -jnp.mean(x - nv_sample, axis=0)
+        if conf.sparsity > 0 or conf.apply_sparsity:
+            hb_grad = hb_grad + (jnp.mean(ph_mean, axis=0) - conf.sparsity)
+        if conf.use_regularization and conf.l2 > 0:
+            w_grad = w_grad + conf.l2 * params[W]
+        grads = {W: w_grad.astype(params[W].dtype),
+                 B: hb_grad.astype(params[B].dtype),
+                 VBIAS: vb_grad.astype(params[VBIAS].dtype)}
+        score = losses_mod.reconstruction_crossentropy(x, nv_mean)
+        return score, grads
+
+    def reconstruct(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        return self.prop_down(params, self.prop_up(params, x))
+
+
+class RecursiveAutoEncoder(BasePretrainLayer):
+    """Recursive AE over a left-fold of the input rows.
+
+    Capability match of ``models/featuredetectors/autoencoder/recursive/
+    RecursiveAutoEncoder.java``: combine the running parent representation
+    with the next input row, encode, accumulate reconstruction loss.  The
+    recursion is a ``lax.scan`` over rows — compiler-friendly control flow
+    instead of host recursion.  Requires n_in == n_out (representation size).
+    """
+
+    kind = LayerKind.RECURSIVE_AUTOENCODER
+    param_order = (W, B, VBIAS)
+
+    def init(self, key) -> Params:
+        conf = self.conf
+        pol = get_policy()
+        kw, _ = jax.random.split(key)
+        # combine [parent; child] (2*d) -> d
+        w = init_from_conf(kw, (2 * conf.n_in, conf.n_out), conf)
+        return {W: w, B: jnp.zeros((conf.n_out,), pol.param_dtype),
+                VBIAS: jnp.zeros((2 * conf.n_in,), pol.param_dtype)}
+
+    def combine(self, params: Params, parent: jnp.ndarray, child: jnp.ndarray):
+        z = jnp.concatenate([parent, child], axis=-1)
+        h = act.apply(self.conf.activation, z @ params[W] + params[B])
+        recon = act.apply(self.conf.activation, h @ params[W].T + params[VBIAS])
+        loss = jnp.mean((recon - z) ** 2)
+        return h, loss
+
+    def pretrain_loss(self, params: Params, x: jnp.ndarray, key=None) -> jnp.ndarray:
+        def body(parent, child):
+            h, l = self.combine(params, parent, child)
+            return h, l
+
+        parent0 = x[0]
+        _, ls = jax.lax.scan(body, parent0, x[1:])
+        return jnp.mean(ls)
+
+    def pretrain_value_and_grad(self, params: Params, x: jnp.ndarray, key):
+        return jax.value_and_grad(self.pretrain_loss)(params, x, key)
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        def body(parent, child):
+            h, _ = self.combine(params, parent, child)
+            return h, h
+
+        parent0 = x[0]
+        _, hs = jax.lax.scan(body, parent0, x[1:])
+        return jnp.concatenate([x[:1], hs], axis=0)
+
+
+# --------------------------------------------------------------------------- recurrent
+
+class LSTM(Layer):
+    """Single-layer LSTM (char-rnn style).
+
+    Capability match of ``models/classifiers/lstm/LSTM.java:33-140``: the
+    i/f/o/g gates live in ONE concatenated weight matrix (the reference's
+    ``iFog``), input is [1, x_t, h_{t-1}] (leading 1 folds the bias in, as
+    the reference hstacks a ones column), decoder head produces per-step
+    softmax.  The manual BPTT (``:63-140``) is replaced by autodiff through
+    ``lax.scan``; beam-search decode (``:241-340``) lives in
+    ``models/classifiers`` (host-side).
+    """
+
+    kind = LayerKind.LSTM
+    param_order = (REC_W, DEC_W, DEC_B)
+
+    def init(self, key) -> Params:
+        conf = self.conf
+        pol = get_policy()
+        d = conf.hidden_size or conf.n_out
+        k1, k2 = jax.random.split(key)
+        rec = init_from_conf(k1, (1 + conf.n_in + d, 4 * d), conf)
+        dec = init_from_conf(k2, (d, conf.n_out), conf)
+        return {REC_W: rec, DEC_W: dec, DEC_B: jnp.zeros((conf.n_out,), pol.param_dtype)}
+
+    def _step(self, params: Params, carry, x_t):
+        h_prev, c_prev = carry
+        d = h_prev.shape[-1]
+        inp = jnp.concatenate([jnp.ones(x_t.shape[:-1] + (1,), x_t.dtype), x_t, h_prev], axis=-1)
+        gates = inp @ params[REC_W]
+        i = jax.nn.sigmoid(gates[..., 0:d])
+        f = jax.nn.sigmoid(gates[..., d:2 * d])
+        o = jax.nn.sigmoid(gates[..., 2 * d:3 * d])
+        g = jnp.tanh(gates[..., 3 * d:4 * d])
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    def hidden_states(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        """x: (T, n_in) or (B, T, n_in) -> hidden (T, d) / (B, T, d)."""
+        d = (self.conf.hidden_size or self.conf.n_out)
+        batched = x.ndim == 3
+        if batched:
+            bsz = x.shape[0]
+            carry0 = (jnp.zeros((bsz, d), x.dtype), jnp.zeros((bsz, d), x.dtype))
+            xs = jnp.swapaxes(x, 0, 1)  # (T, B, n_in)
+        else:
+            carry0 = (jnp.zeros((d,), x.dtype), jnp.zeros((d,), x.dtype))
+            xs = x
+        _, hs = jax.lax.scan(lambda c, xt: self._step(params, c, xt), carry0, xs)
+        return jnp.swapaxes(hs, 0, 1) if batched else hs
+
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        hs = self.hidden_states(params, x)
+        return hs @ params[DEC_W] + params[DEC_B]
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        if train and self.conf.dropout > 0 and rng is not None:
+            x = x * dropout_mask(rng, x.shape, self.conf.dropout, x.dtype)
+        return act.apply(self.conf.activation, self.pre_output(params, x))
+
+    def loss(self, params: Params, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+        """Per-step softmax cross entropy (the reference trains x -> x shifted)."""
+        logits = self.pre_output(params, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+# --------------------------------------------------------------------------- conv
+
+class ConvolutionDownSampleLayer(Layer):
+    """conv2d + bias + activation + max-pool.
+
+    Capability match of ``nn/layers/convolution/ConvolutionDownSampleLayer
+    .java:21,33-80`` (forward); backward comes free via autodiff — the
+    reference's backward is unimplemented (``:105-112``).
+
+    Layout: NHWC (TPU-native); weights HWIO.  The reference used
+    [examples, channels, rows, cols]; NHWC keeps XLA conv layout-optimal.
+    """
+
+    kind = LayerKind.CONVOLUTION_DOWNSAMPLE
+    param_order = (CONV_W, CONV_B)
+
+    def init(self, key) -> Params:
+        conf = self.conf
+        pol = get_policy()
+        fh, fw = conf.filter_size
+        cin = conf.n_in or 1
+        kw, _ = jax.random.split(key)
+        w = init_from_conf(kw, (fh, fw, cin, conf.num_filters), conf)
+        return {CONV_W: w, CONV_B: jnp.zeros((conf.num_filters,), pol.param_dtype)}
+
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        pol = get_policy()
+        x4 = x if x.ndim == 4 else x[..., None]
+        y = jax.lax.conv_general_dilated(
+            pol.cast_compute(x4), pol.cast_compute(params[CONV_W]),
+            window_strides=(1, 1), padding=self.conf.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + params[CONV_B].astype(y.dtype)
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        y = act.apply(self.conf.activation, self.pre_output(params, x))
+        sh, sw = self.conf.stride
+        return jax.lax.reduce_window(
+            y, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, sh, sw, 1), window_strides=(1, sh, sw, 1),
+            padding="VALID",
+        )
+
+
+# --------------------------------------------------------------------------- beyond-v0 blocks
+
+class BatchNorm(Layer):
+    """Batch normalization (beyond-v0; needed by the ResNet north star)."""
+
+    kind = LayerKind.BATCHNORM
+    param_order = ("scale", "bias")
+
+    def init(self, key) -> Params:
+        pol = get_policy()
+        d = self.conf.n_out or self.conf.n_in
+        return {"scale": jnp.ones((d,), pol.param_dtype),
+                "bias": jnp.zeros((d,), pol.param_dtype)}
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        axes = tuple(range(x.ndim - 1))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        xn = (x - mean) * jax.lax.rsqrt(var + 1e-5)
+        return xn * params["scale"] + params["bias"]
+
+
+class Embedding(Layer):
+    """Token embedding lookup (beyond-v0; BERT north star + NLP stack)."""
+
+    kind = LayerKind.EMBEDDING
+    param_order = (W,)
+
+    def init(self, key) -> Params:
+        conf = self.conf
+        return {W: init_from_conf(key, (conf.n_in, conf.n_out), conf)}
+
+    def activate(self, params: Params, x: jnp.ndarray, *, rng=None, train=False):
+        return jnp.take(params[W], x, axis=0)
+
+    def pre_output(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+        return self.activate(params, x)
+
+
+# --------------------------------------------------------------------------- registry
+
+REGISTRY: dict[LayerKind, type[Layer]] = {
+    LayerKind.DENSE: DenseLayer,
+    LayerKind.OUTPUT: OutputLayer,
+    LayerKind.RBM: RBM,
+    LayerKind.AUTOENCODER: AutoEncoder,
+    LayerKind.RECURSIVE_AUTOENCODER: RecursiveAutoEncoder,
+    LayerKind.LSTM: LSTM,
+    LayerKind.CONVOLUTION_DOWNSAMPLE: ConvolutionDownSampleLayer,
+    LayerKind.BATCHNORM: BatchNorm,
+    LayerKind.EMBEDDING: Embedding,
+}
+
+
+def create_layer(conf: NeuralNetConfiguration) -> Layer:
+    """``LayerFactories.getFactory(conf).create(conf)`` equivalent."""
+    try:
+        cls = REGISTRY[conf.kind]
+    except KeyError:
+        raise ValueError(f"no layer registered for kind {conf.kind}") from None
+    return cls(conf)

@@ -1,0 +1,52 @@
+"""Weight initialization schemes.
+
+Mirrors ``nn/weights/WeightInit.java:7-16`` + ``WeightInitUtil.java`` of the
+reference: VI (Glorot-like fan-sum uniform), ZERO, SIZE, DISTRIBUTION,
+NORMALIZED, UNIFORM.  Stateless: every init takes an explicit threefry key.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.dtypes import get_policy
+from .conf import Distribution, NeuralNetConfiguration, WeightInit
+
+
+def init_weights(key, shape: tuple[int, ...], scheme: WeightInit,
+                 dist: Distribution = Distribution.NORMAL, dist_std: float = 1e-2,
+                 dtype=None) -> jnp.ndarray:
+    """Create a weight matrix per the named scheme.
+
+    VI follows the reference formula: U(-r, r) with
+    r = sqrt(6) / sqrt(fan_in + fan_out + 1)  (``WeightInitUtil.java``).
+    """
+    dtype = dtype or get_policy().param_dtype
+    fan_in = shape[0] if len(shape) >= 1 else 1
+    fan_out = shape[-1] if len(shape) >= 2 else 1
+    scheme = WeightInit(scheme)
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.VI:
+        r = jnp.sqrt(6.0) / jnp.sqrt(fan_in + fan_out + 1.0)
+        return jax.random.uniform(key, shape, dtype, -r, r)
+    if scheme == WeightInit.SIZE:
+        # scale by 1/sqrt(fan_in)
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(float(fan_in))
+    if scheme == WeightInit.UNIFORM:
+        a = 1.0 / float(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == WeightInit.NORMALIZED:
+        w = jax.random.uniform(key, shape, dtype)
+        return (w - w.mean()) / (w.std() + 1e-12)
+    if scheme == WeightInit.DISTRIBUTION:
+        if Distribution(dist) == Distribution.UNIFORM:
+            return jax.random.uniform(key, shape, dtype, -dist_std, dist_std)
+        return dist_std * jax.random.normal(key, shape, dtype)
+    raise ValueError(f"unknown weight init {scheme}")
+
+
+def init_from_conf(key, shape: tuple[int, ...], conf: NeuralNetConfiguration,
+                   dtype=None) -> jnp.ndarray:
+    return init_weights(key, shape, conf.weight_init, conf.dist, conf.dist_std, dtype)

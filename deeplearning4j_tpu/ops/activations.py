@@ -23,6 +23,10 @@ Activation = Callable[[jnp.ndarray], jnp.ndarray]
 
 _REGISTRY: dict[str, Activation] = {}
 _DERIVATIVES: dict[str, Activation] = {}
+# Row-wise (non-elementwise) activations: the vmapped-grad fallback in
+# apply_derivative is meaningless for these (a 1-element softmax row is
+# constant), so they must either have an explicit derivative or reject.
+_ROWWISE = {"logsoftmax"}
 
 
 def register(name: str, fn: Activation, deriv: Activation | None = None):
@@ -56,6 +60,11 @@ def apply_derivative(name: str, x: jnp.ndarray) -> jnp.ndarray:
     """
     if name in _DERIVATIVES:
         return _DERIVATIVES[name](x)
+    if name in _ROWWISE:
+        raise ValueError(
+            f"activation {name!r} is row-wise (not elementwise); its full "
+            "Jacobian is handled by autodiff in the training path — "
+            "apply_derivative has no elementwise meaning for it")
     fn = get(name)
     # Fallback: elementwise derivative via vmapped grad.
     flat = x.reshape(-1)

@@ -35,6 +35,8 @@ def dropout_mask(key, shape, rate: float, dtype=jnp.float32) -> jnp.ndarray:
     """
     if rate <= 0.0:
         return jnp.ones(shape, dtype)
+    if rate >= 1.0:
+        return jnp.zeros(shape, dtype)
     keep = 1.0 - rate
     return jax.random.bernoulli(key, keep, shape).astype(dtype) / keep
 

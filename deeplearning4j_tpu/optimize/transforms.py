@@ -1,0 +1,158 @@
+"""Gradient transforms (optax-style) mirroring the reference's gradient
+post-processing chain.
+
+``optimize/solvers/BaseOptimizer.updateGradientAccordingToParams``
+(``BaseOptimizer.java:68-118``) applies, in order: AdaGrad per-param learning
+rates, momentum (with per-iteration schedule), L2 weight decay, clip to unit
+norm, and divide-by-batch-size.  Here each is a pure ``(init, update)``
+GradientTransform; ``chain`` composes them; ``from_conf`` assembles the
+reference's exact chain from a ``NeuralNetConfiguration``.
+
+State lives in device arrays (pytrees) so the whole update is jittable —
+the AdaGrad historical-gradient state is the TPU equivalent of the
+reference's ``org.nd4j.linalg.learning.AdaGrad`` mutable learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.conf import NeuralNetConfiguration
+
+tree_map = jax.tree_util.tree_map
+
+
+class GradientTransform(NamedTuple):
+    init: Callable[[Any], Any]           # params -> state
+    update: Callable[[Any, Any, Any, Any], tuple[Any, Any]]
+    # (grads, state, params, iteration) -> (new_grads, new_state)
+
+
+def chain(*transforms: GradientTransform) -> GradientTransform:
+    def init(params):
+        return tuple(t.init(params) for t in transforms)
+
+    def update(grads, state, params=None, iteration=0):
+        new_state = []
+        for t, s in zip(transforms, state):
+            grads, s2 = t.update(grads, s, params, iteration)
+            new_state.append(s2)
+        return grads, tuple(new_state)
+
+    return GradientTransform(init, update)
+
+
+def identity() -> GradientTransform:
+    return GradientTransform(lambda p: (), lambda g, s, p=None, i=0: (g, s))
+
+
+def scale(factor: float) -> GradientTransform:
+    return GradientTransform(lambda p: (),
+                             lambda g, s, p=None, i=0: (tree_map(lambda x: factor * x, g), s))
+
+
+def adagrad(lr: float, eps: float = 1e-6) -> GradientTransform:
+    """Per-parameter adaptive LR (reference: nd4j ``AdaGrad``,
+    ``BaseOptimizer.java:29,68-118``): g * lr / sqrt(sum g^2 + eps)."""
+
+    def init(params):
+        return tree_map(jnp.zeros_like, params)
+
+    def update(grads, hist, params=None, iteration=0):
+        hist = tree_map(lambda h, g: h + g * g, hist, grads)
+        out = tree_map(lambda g, h: lr * g * jax.lax.rsqrt(h + eps), grads, hist)
+        return out, hist
+
+    return GradientTransform(init, update)
+
+
+def sgd_lr(lr: float) -> GradientTransform:
+    return scale(lr)
+
+
+def momentum(base: float, schedule: dict[int, float] | None = None) -> GradientTransform:
+    """Heavy-ball momentum with optional iteration schedule
+    (``BaseOptimizer.java:75-84``): v = m*v + g; emit v."""
+    schedule = dict(schedule or {})
+
+    def momentum_at(iteration):
+        if not schedule:
+            return base
+        its = jnp.array(sorted(schedule.keys()))
+        vals = jnp.array([schedule[int(i)] for i in sorted(schedule.keys())])
+        # piecewise-constant lookup, jit-safe
+        idx = jnp.sum(its <= iteration) - 1
+        return jnp.where(idx >= 0, vals[jnp.maximum(idx, 0)], base)
+
+    def init(params):
+        return tree_map(jnp.zeros_like, params)
+
+    def update(grads, vel, params=None, iteration=0):
+        m = momentum_at(iteration)
+        vel = tree_map(lambda v, g: m * v + g, vel, grads)
+        return vel, vel
+
+    return GradientTransform(init, update)
+
+
+def weight_decay(l2: float) -> GradientTransform:
+    """L2 regularization contribution g += l2 * w (``BaseOptimizer.java``)."""
+
+    def update(grads, s, params=None, iteration=0):
+        if params is None:
+            return grads, s
+        return tree_map(lambda g, w: g + l2 * w, grads, params), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+def clip_unit_norm() -> GradientTransform:
+    """``constrainGradientToUnitNorm``: scale full gradient to unit L2."""
+    from ..utils import tree_math as tm
+
+    def update(grads, s, params=None, iteration=0):
+        return tm.unit_norm(grads), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+def clip_by_global_norm(max_norm: float) -> GradientTransform:
+    from ..utils import tree_math as tm
+
+    def update(grads, s, params=None, iteration=0):
+        return tm.clip_by_global_norm(grads, max_norm), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+def divide_by_batch(batch_size_fn: Callable[[], float] | float) -> GradientTransform:
+    def update(grads, s, params=None, iteration=0):
+        bs = batch_size_fn() if callable(batch_size_fn) else batch_size_fn
+        return tree_map(lambda g: g / bs, grads), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+def from_conf(conf: NeuralNetConfiguration) -> GradientTransform:
+    """Assemble the reference's exact post-processing chain from a conf
+    (order per ``BaseOptimizer.java:68-118``)."""
+    parts: list[GradientTransform] = []
+    if conf.use_regularization and conf.l2 > 0:
+        parts.append(weight_decay(conf.l2))
+    if conf.use_adagrad:
+        parts.append(adagrad(conf.lr))
+    else:
+        parts.append(sgd_lr(conf.lr))
+    if conf.momentum > 0 or conf.momentum_schedule:
+        parts.append(momentum(conf.momentum, conf.momentum_schedule))
+    if conf.constrain_gradient_to_unit_norm:
+        parts.append(clip_unit_norm())
+    return chain(*parts) if parts else identity()
+
+
+def apply_updates(params, updates):
+    """Gradient-descent application: params - updates."""
+    return tree_map(lambda p, u: p - u.astype(p.dtype), params, updates)

@@ -1,0 +1,5 @@
+"""Shared host-side utilities (reference: ``util/*``, ``berkeley/*``)."""
+
+from . import tree_math
+
+__all__ = ["tree_math"]

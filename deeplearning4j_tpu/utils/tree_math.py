@@ -1,0 +1,66 @@
+"""Pytree vector-space math used by the optimization engine.
+
+The reference flattens params into one row vector and uses BLAS level-1 ops
+(``MultiLayerNetwork.pack/params:744-788``, ``BaseOptimizer``).  Here the
+natural representation is the pytree itself; these helpers give the same
+axpy/dot/norm vocabulary over arbitrary param pytrees without materializing a
+flat copy (XLA fuses the elementwise maps).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+tree_map = jax.tree_util.tree_map
+
+
+def add(a, b):
+    return tree_map(jnp.add, a, b)
+
+
+def sub(a, b):
+    return tree_map(jnp.subtract, a, b)
+
+
+def scale(s, a):
+    return tree_map(lambda x: s * x, a)
+
+
+def axpy(s, a, b):
+    """b + s*a."""
+    return tree_map(lambda x, y: y + s * x, a, b)
+
+
+def dot(a, b) -> jnp.ndarray:
+    leaves = tree_map(lambda x, y: jnp.sum(x * y), a, b)
+    return jax.tree_util.tree_reduce(jnp.add, leaves)
+
+
+def norm2(a) -> jnp.ndarray:
+    return jnp.sqrt(dot(a, a))
+
+
+def neg(a):
+    return tree_map(jnp.negative, a)
+
+
+def zeros_like(a):
+    return tree_map(jnp.zeros_like, a)
+
+
+def max_abs(a) -> jnp.ndarray:
+    leaves = tree_map(lambda x: jnp.max(jnp.abs(x)), a)
+    return jax.tree_util.tree_reduce(jnp.maximum, leaves)
+
+
+def clip_by_global_norm(a, max_norm: float):
+    n = norm2(a)
+    factor = jnp.minimum(1.0, max_norm / (n + 1e-12))
+    return scale(factor, a)
+
+
+def unit_norm(a):
+    """Scale to unit L2 norm (``constrainGradientToUnitNorm``)."""
+    n = norm2(a)
+    return scale(1.0 / (n + 1e-12), a)

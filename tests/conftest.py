@@ -3,16 +3,28 @@
 Tests run on a virtual 8-device CPU mesh (SURVEY.md §4 implication (c)): the
 collectives layer is exercised on one host with
 ``--xla_force_host_platform_device_count=8``, mirroring the reference's
-"distributed-without-a-cluster" pattern (``BaseTestDistributed``).  These env
-vars MUST be set before jax initializes, hence this module-level block.
+"distributed-without-a-cluster" pattern (``BaseTestDistributed``).
+
+IMPORTANT environment quirk: the driver boots every interpreter through a
+``sitecustomize`` that imports jax and registers the tunneled real-TPU
+platform ("axon") with ``JAX_PLATFORMS=axon`` already set.  Tests must NOT
+ride the tunnel (per-op dispatch round-trips make eager paths orders of
+magnitude slower, and a held grant can hang ``jax.devices()`` outright), so
+we both set the env vars (for subprocesses) and call
+``jax.config.update("jax_platforms", "cpu")`` (effective post-import).
+bench.py is the only place that uses the real chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,55 @@
+"""Config serde round-trips (mirror of the reference's
+NeuralNetConfigurationTest / MultiLayerNeuralNetConfigurationTest)."""
+
+from deeplearning4j_tpu.nn.conf import (
+    Configuration,
+    LayerKind,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+    list_builder,
+)
+from deeplearning4j_tpu.ops.losses import LossFunction
+
+
+def test_neural_net_conf_json_roundtrip():
+    conf = NeuralNetConfiguration(
+        lr=1e-2, momentum=0.9, momentum_schedule={10: 0.5, 100: 0.99},
+        l2=1e-4, use_regularization=True, n_in=4, n_out=3,
+        kind=LayerKind.OUTPUT, activation="softmax", loss=LossFunction.MCXENT,
+        optimization_algo=OptimizationAlgorithm.LBFGS, k=3,
+        filter_size=(5, 5), stride=(2, 2),
+    )
+    back = NeuralNetConfiguration.from_json(conf.to_json())
+    assert back == conf
+
+
+def test_multilayer_conf_roundtrip_and_list_builder():
+    base = NeuralNetConfiguration(n_in=4, n_out=3, kind=LayerKind.RBM)
+    mlc = (list_builder(base, 3)
+           .hidden_layer_sizes(10, 5)
+           .override(2, kind="output", activation="softmax", loss="mcxent")
+           .pretrain(True)
+           .build())
+    assert mlc.n_layers == 3
+    assert mlc.confs[0].n_in == 4 and mlc.confs[0].n_out == 10
+    assert mlc.confs[1].n_in == 10 and mlc.confs[1].n_out == 5
+    assert mlc.confs[2].n_in == 5 and mlc.confs[2].n_out == 3
+    assert mlc.confs[2].kind == LayerKind.OUTPUT
+    back = MultiLayerConfiguration.from_json(mlc.to_json())
+    assert back == mlc
+
+
+def test_momentum_schedule_lookup():
+    conf = NeuralNetConfiguration(momentum=0.5, momentum_schedule={10: 0.9})
+    assert conf.momentum_at(0) == 0.5
+    assert conf.momentum_at(10) == 0.9
+    assert conf.momentum_at(500) == 0.9
+
+
+def test_kv_configuration_substitution():
+    c = Configuration({"root": "/tmp", "path": "${root}/data", "n": "5", "flag": "true"})
+    assert c.get_str("path") == "/tmp/data"
+    assert c.get_int("n") == 5
+    assert c.get_bool("flag") is True
+    assert c.get_bool("missing", default=True) is True

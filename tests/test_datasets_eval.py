@@ -1,0 +1,158 @@
+"""Data layer + evaluation tests (mirror of the reference's iterator tests,
+EvalTest, and the TestDataSetIterator fixture pattern)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    CSVDataSetIterator,
+    DataSet,
+    DigitsDataSetIterator,
+    IrisDataSetIterator,
+    ListDataSetIterator,
+    MnistDataSetIterator,
+    MovingWindowDataSetIterator,
+    MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.dataset import to_outcome_matrix
+from deeplearning4j_tpu.datasets.mnist_idx import (
+    read_idx_images, read_idx_labels, write_idx_images, write_idx_labels,
+)
+from deeplearning4j_tpu.evaluation import ConfusionMatrix, Evaluation
+
+
+def test_outcome_matrix():
+    m = to_outcome_matrix([0, 2, 1], 3)
+    np.testing.assert_array_equal(m, [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+
+def test_dataset_pipeline_ops():
+    ds = DataSet(np.arange(20, dtype=np.float32).reshape(10, 2),
+                 to_outcome_matrix([0, 1] * 5, 2))
+    sh = ds.shuffle(seed=0)
+    assert sh.num_examples() == 10 and not np.array_equal(sh.features, ds.features)
+    train, test = ds.split_test_and_train(7)
+    assert train.num_examples() == 7 and test.num_examples() == 3
+    norm = ds.normalize_zero_mean_unit_variance()
+    np.testing.assert_allclose(norm.features.mean(axis=0), 0, atol=1e-5)
+    filtered = ds.filter_by_outcome([1])
+    assert filtered.num_examples() == 5
+    batches = ds.batch_by(4)
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+    assert ds.sample(6, seed=1).num_examples() == 6
+
+
+def test_iris_iterator():
+    it = IrisDataSetIterator(batch=50)
+    assert it.total_examples() == 150
+    assert it.input_columns() == 4
+    assert it.total_outcomes() == 3
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+
+
+def test_digits_iterator():
+    it = DigitsDataSetIterator(batch=500)
+    assert it.total_outcomes() == 10
+    b = it.next()
+    assert b.features.shape == (500, 64)
+    assert b.features.max() <= 1.0
+
+
+def test_mnist_fallback_shape():
+    it = MnistDataSetIterator(batch=10)
+    b = it.next()
+    assert b.features.shape == (10, 784)
+    assert set(np.unique(b.features)).issubset({0.0, 1.0})  # binarized
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    imgs = (np.random.default_rng(0).random((5, 28, 28)) * 255).astype(np.uint8)
+    labels = np.array([1, 2, 3, 4, 5], dtype=np.uint8)
+    write_idx_images(tmp_path / "img", imgs)
+    write_idx_labels(tmp_path / "lbl", labels)
+    np.testing.assert_array_equal(read_idx_images(tmp_path / "img"), imgs)
+    np.testing.assert_array_equal(read_idx_labels(tmp_path / "lbl"), labels)
+
+
+def test_csv_iterator(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("1.0,2.0,setosa\n3.0,4.0,virginica\n5.0,6.0,setosa\n")
+    it = CSVDataSetIterator(batch=2, num_examples=3, path=p, label_col=2)
+    b = it.next()
+    assert b.features.shape == (2, 2)
+    assert it.total_outcomes() == 2
+
+
+def test_wrappers():
+    ds = DataSet(np.random.default_rng(0).random((10, 4)).astype(np.float32),
+                 to_outcome_matrix([0, 1] * 5, 2))
+    inner = ListDataSetIterator(ds, batch=5)
+    multi = MultipleEpochsIterator(3, inner)
+    assert sum(b.num_examples() for b in multi) == 30
+    samp = SamplingDataSetIterator(ds, batch=4, total_batches=5, seed=0)
+    assert sum(b.num_examples() for b in samp) == 20
+    recon = ReconstructionDataSetIterator(ListDataSetIterator(ds, batch=5))
+    b = recon.next()
+    np.testing.assert_array_equal(b.features, b.labels)
+    tw = TestDataSetIterator(ds, batch=3)
+    assert sum(b.num_examples() for b in tw) == 10
+
+
+def test_moving_window_iterator():
+    ds = DataSet(np.random.default_rng(0).random((2, 16)).astype(np.float32),
+                 to_outcome_matrix([0, 1], 2))
+    it = MovingWindowDataSetIterator(batch=4, data=ds, window_rows=2, window_cols=2)
+    b = it.next()
+    assert b.features.shape == (4, 4)
+    assert it.total_examples() == 2 * 4  # 4 windows per 4x4 image
+
+
+def test_preprocessor_hook():
+    ds = DataSet(np.ones((4, 2), np.float32) * 10, to_outcome_matrix([0, 1, 0, 1], 2))
+    it = ListDataSetIterator(ds, batch=2)
+    it.set_pre_processor(lambda d: DataSet(d.features / 10.0, d.labels))
+    assert it.next().features.max() == 1.0
+
+
+def test_confusion_matrix():
+    cm = ConfusionMatrix()
+    cm.add("a", "a", 3)
+    cm.add("a", "b", 1)
+    cm.add("b", "b", 2)
+    assert cm.count("a", "a") == 3
+    assert cm.actual_total("a") == 4
+    assert cm.predicted_total("b") == 3
+    assert cm.total() == 6
+
+
+def test_evaluation_metrics():
+    ev = Evaluation()
+    actual = to_outcome_matrix([0, 0, 1, 1, 2, 2], 3)
+    guess = to_outcome_matrix([0, 1, 1, 1, 2, 0], 3)
+    ev.eval(actual, guess)
+    assert ev.accuracy() == pytest.approx(4 / 6)
+    assert ev.precision(1) == pytest.approx(2 / 3)
+    assert ev.recall(0) == pytest.approx(1 / 2)
+    assert 0 < ev.f1() <= 1
+    assert "Accuracy" in ev.stats()
+
+
+def test_evaluation_perfect():
+    ev = Evaluation()
+    y = to_outcome_matrix([0, 1, 2], 3)
+    ev.eval(y, y)
+    assert ev.accuracy() == 1.0 and ev.f1() == 1.0
+
+
+def test_evaluation_merge():
+    y1 = to_outcome_matrix([0, 1], 2)
+    ev1, ev2 = Evaluation(), Evaluation()
+    ev1.eval(y1, y1)
+    ev2.eval(y1, to_outcome_matrix([1, 1], 2))
+    ev1.merge(ev2)
+    assert ev1.accuracy() == pytest.approx(3 / 4)

@@ -1,0 +1,140 @@
+"""MultiLayerNetwork end-to-end tests — the minimum vertical slice.
+
+Mirror of the reference's ``nn/multilayer/MultiLayerTest.java:33-70`` (DBN on
+Iris with F1 assertion) and ``models/layers/OutputLayerTest.java``, exercising
+config -> init -> fit -> optimize -> eval.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, IrisDataSetIterator
+from deeplearning4j_tpu.nn import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    LayerKind,
+    NeuralNetConfiguration,
+    OptimizationAlgorithm,
+    list_builder,
+)
+
+
+def iris_data():
+    it = IrisDataSetIterator(batch=150)
+    ds = it.next().normalize_zero_mean_unit_variance().shuffle(seed=42)
+    return ds
+
+
+def mlp_conf(n_iter=200, algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT):
+    base = NeuralNetConfiguration(
+        n_in=4, n_out=3, lr=0.1, momentum=0.9, use_adagrad=True,
+        num_iterations=n_iter, optimization_algo=algo, activation="tanh",
+    )
+    return (list_builder(base, 2)
+            .hidden_layer_sizes(10)
+            .override(1, kind="output", activation="softmax", loss="mcxent")
+            .pretrain(False)
+            .build())
+
+
+def test_mlp_iris_convergence():
+    """2-layer MLP reaches F1 >= 0.9 on Iris (the reference test asserts
+    f1 > 0.9 for its 3-layer DBN)."""
+    ds = iris_data()
+    net = MultiLayerNetwork(mlp_conf())
+    net.init(jax.random.key(0))
+    net.fit(ds)
+    ev = net.evaluate(ds)
+    assert ev.f1() >= 0.9, ev.stats()
+
+
+def test_dbn_pretrain_then_finetune_iris():
+    """RBM pretraining + supervised finetune (greedy layerwise, mirror of
+    MultiLayerTest's DBN)."""
+    ds = iris_data().scale_minmax()
+    base = NeuralNetConfiguration(
+        n_in=4, n_out=3, lr=0.05, use_adagrad=True, num_iterations=60,
+        kind=LayerKind.RBM, visible_unit="gaussian", hidden_unit="binary",
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        activation="sigmoid", k=1,
+    )
+    conf = (list_builder(base, 3)
+            .hidden_layer_sizes(12, 8)
+            .override(2, kind="output", activation="softmax", loss="mcxent",
+                      num_iterations=300, lr=0.1)
+            .pretrain(True)
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init(jax.random.key(1))
+    net.fit(ds)
+    ev = net.evaluate(ds)
+    assert ev.f1() >= 0.8, ev.stats()
+
+
+def test_output_layer_alone_iris():
+    """Softmax regression on Iris via CG (OutputLayerTest mirror)."""
+    ds = iris_data()
+    base = NeuralNetConfiguration(
+        n_in=4, n_out=3, num_iterations=100, use_adagrad=False, lr=0.5,
+        optimization_algo=OptimizationAlgorithm.CONJUGATE_GRADIENT,
+        kind=LayerKind.OUTPUT, activation="softmax", loss="mcxent",
+    )
+    conf = list_builder(base, 1).pretrain(False).build()
+    net = MultiLayerNetwork(conf)
+    net.init(jax.random.key(2))
+    net.fit(ds)
+    assert net.evaluate(ds).f1() >= 0.9
+
+
+def test_predict_and_probabilities():
+    ds = iris_data()
+    net = MultiLayerNetwork(mlp_conf(n_iter=50))
+    net.init(jax.random.key(0))
+    net.fit(ds)
+    preds = net.predict(ds.features[:10])
+    assert preds.shape == (10,)
+    probs = np.asarray(net.label_probabilities(ds.features[:10]))
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_params_flatten_roundtrip_network():
+    net = MultiLayerNetwork(mlp_conf())
+    net.init(jax.random.key(0))
+    flat = net.params_flat()
+    assert flat.shape == (net.num_params(),)
+    out0 = np.asarray(net.output(np.ones((2, 4), np.float32)))
+    net.set_params_flat(np.asarray(flat))
+    out1 = np.asarray(net.output(np.ones((2, 4), np.float32)))
+    np.testing.assert_allclose(out0, out1, rtol=1e-6)
+
+
+def test_merge_parameter_averaging():
+    n1 = MultiLayerNetwork(mlp_conf())
+    n2 = MultiLayerNetwork(mlp_conf())
+    n1.init(jax.random.key(0))
+    n2.init(jax.random.key(1))
+    w1 = np.asarray(n1.params[0]["W"])
+    w2 = np.asarray(n2.params[0]["W"])
+    n1.merge(n2)
+    np.testing.assert_allclose(np.asarray(n1.params[0]["W"]), (w1 + w2) / 2, rtol=1e-6)
+
+
+def test_save_load_roundtrip(tmp_path):
+    ds = iris_data()
+    net = MultiLayerNetwork(mlp_conf(n_iter=30))
+    net.init(jax.random.key(0))
+    net.fit(ds)
+    p = tmp_path / "model.bin"
+    net.save(p)
+    loaded = MultiLayerNetwork.load(p)
+    np.testing.assert_allclose(np.asarray(loaded.output(ds.features[:5])),
+                               np.asarray(net.output(ds.features[:5])), rtol=1e-6)
+
+
+def test_score_decreases_during_training():
+    ds = iris_data()
+    net = MultiLayerNetwork(mlp_conf(n_iter=5))
+    net.init(jax.random.key(0))
+    s0 = net.score(ds)
+    net.fit(ds)
+    assert net.score(ds) < s0

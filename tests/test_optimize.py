@@ -1,0 +1,138 @@
+"""Optimization engine tests: transforms chain (AdaGrad parity with the
+reference's learner), solvers on convex objectives, line search, HF."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, OptimizationAlgorithm
+from deeplearning4j_tpu.optimize import transforms as tfm
+from deeplearning4j_tpu.optimize.api import EpsTermination, Norm2Termination, ScoreIterationListener
+from deeplearning4j_tpu.optimize.solvers import (
+    BackTrackLineSearch,
+    ConjugateGradient,
+    IterationGradientDescent,
+    LBFGS,
+    Solver,
+    StochasticHessianFree,
+)
+
+
+def quadratic_objective(center):
+    """f(p) = 0.5*||p - c||^2 — minimized at c."""
+    def obj(params, key):
+        diff = params["x"] - center
+        loss = 0.5 * jnp.sum(diff ** 2)
+        return loss, {"x": diff}
+    return obj
+
+
+def rosenbrock_objective():
+    def f(params, key=None):
+        x, y = params["x"][0], params["x"][1]
+        return (1 - x) ** 2 + 100 * (y - x * x) ** 2
+
+    def obj(params, key):
+        return f(params, key), jax.grad(lambda p: f(p))(params)
+    return obj
+
+
+def _conf(algo, iters=100, **kw):
+    kw.setdefault("lr", 0.1)
+    return NeuralNetConfiguration(optimization_algo=algo, num_iterations=iters,
+                                  use_adagrad=False, momentum=0.0, **kw)
+
+
+def test_adagrad_transform_math():
+    """First AdaGrad step: lr * g / sqrt(g^2 + eps) ≈ lr (mirror of
+    AdaGradTest)."""
+    t = tfm.adagrad(lr=0.5, eps=1e-12)
+    params = {"w": jnp.array([1.0, 2.0])}
+    grads = {"w": jnp.array([10.0, -4.0])}
+    state = t.init(params)
+    out, state = t.update(grads, state, params, 0)
+    np.testing.assert_allclose(np.asarray(out["w"]), [0.5, -0.5], rtol=1e-5)
+    # second identical step shrinks by sqrt(2)
+    out2, _ = t.update(grads, state, params, 1)
+    np.testing.assert_allclose(np.asarray(out2["w"]), [0.5 / np.sqrt(2), -0.5 / np.sqrt(2)], rtol=1e-4)
+
+
+def test_momentum_schedule_transform():
+    t = tfm.momentum(0.5, {5: 0.9})
+    params = {"w": jnp.zeros(2)}
+    state = t.init(params)
+    g = {"w": jnp.ones(2)}
+    v1, state = t.update(g, state, params, 0)   # v = 0.5*0 + 1
+    np.testing.assert_allclose(np.asarray(v1["w"]), [1, 1])
+    v2, state = t.update(g, state, params, 6)   # m=0.9 → v = 0.9*1 + 1
+    np.testing.assert_allclose(np.asarray(v2["w"]), [1.9, 1.9], rtol=1e-6)
+
+
+def test_chain_from_conf_runs():
+    conf = NeuralNetConfiguration(use_adagrad=True, momentum=0.9, l2=1e-3,
+                                  use_regularization=True,
+                                  constrain_gradient_to_unit_norm=True)
+    t = tfm.from_conf(conf)
+    params = {"w": jnp.ones(3)}
+    state = t.init(params)
+    out, _ = t.update({"w": jnp.ones(3)}, state, params, 0)
+    np.testing.assert_allclose(float(jnp.linalg.norm(out["w"])), 1.0, rtol=1e-5)
+
+
+def test_backtrack_line_search_armijo():
+    value_fn = lambda p: 0.5 * jnp.sum(p["x"] ** 2)
+    params = {"x": jnp.array([4.0])}
+    grads = {"x": jnp.array([4.0])}
+    direction = {"x": jnp.array([-4.0])}
+    ls = BackTrackLineSearch(value_fn, max_iterations=10)
+    step = ls.optimize(params, direction, grads, initial_step=1.0)
+    assert step > 0
+    new = params["x"] + step * direction["x"]
+    assert abs(float(new[0])) < 4.0
+
+
+@pytest.mark.parametrize("algo", [
+    OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+    OptimizationAlgorithm.GRADIENT_DESCENT,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT,
+    OptimizationAlgorithm.LBFGS,
+    OptimizationAlgorithm.HESSIAN_FREE,
+])
+def test_solvers_minimize_quadratic(algo):
+    center = jnp.array([3.0, -2.0, 1.0])
+    obj = quadratic_objective(center)
+    solver = Solver(_conf(algo, iters=200), obj)
+    result = solver.optimize({"x": jnp.zeros(3)})
+    np.testing.assert_allclose(np.asarray(result.params["x"]), np.asarray(center),
+                               atol=0.2)
+    assert result.score < 0.05
+
+
+def test_lbfgs_beats_gd_on_rosenbrock():
+    obj = rosenbrock_objective()
+    start = {"x": jnp.array([-1.2, 1.0])}
+    lbfgs = LBFGS(_conf(OptimizationAlgorithm.LBFGS, iters=300), obj,
+                  terminations=[Norm2Termination(1e-6)])
+    res = lbfgs.optimize(start)
+    assert res.score < 1e-2
+
+
+def test_hessian_free_damping_adapts():
+    obj = quadratic_objective(jnp.array([1.0, 1.0]))
+    hf = StochasticHessianFree(_conf(OptimizationAlgorithm.HESSIAN_FREE, iters=20),
+                               obj, damping=100.0)
+    res = hf.optimize({"x": jnp.zeros(2)})
+    assert res.score < 1e-3
+    assert hf.damping < 100.0  # good quadratic fit → damping shrinks
+
+
+def test_listener_and_termination():
+    obj = quadratic_objective(jnp.array([1.0]))
+    listener = ScoreIterationListener(print_every=1000)
+    solver = IterationGradientDescent(
+        _conf(OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT, iters=500, lr=0.5),
+        obj, listeners=[listener], terminations=[EpsTermination(1e-9)])
+    res = solver.optimize({"x": jnp.zeros(1)})
+    assert res.converged and res.iterations < 500
+    assert len(listener.scores) == res.iterations
