@@ -63,7 +63,10 @@ class BaseDatasetIterator(_IterBase):
         return self.fetcher.has_more() and self.fetcher.cursor < self._num_examples
 
     def next(self, num: int | None = None) -> DataSet:
-        self.fetcher.fetch(num or self._batch)
+        n = num or self._batch
+        # honor the num_examples cap (fetch clamps only to the full corpus)
+        n = min(n, self._num_examples - self.fetcher.cursor)
+        self.fetcher.fetch(n)
         ds = self.fetcher.next()
         return self.pre_processor(ds) if self.pre_processor else ds
 

@@ -383,7 +383,9 @@ class Configuration(dict):
         out, guard = v, 0
         while "${" in out and guard < 10:
             start = out.index("${")
-            end = out.index("}", start)
+            end = out.find("}", start)
+            if end == -1:  # unclosed ${ — return verbatim rather than crash
+                break
             var = out[start + 2:end]
             out = out[:start] + str(self.get(var, "")) + out[end + 1:]
             guard += 1

@@ -160,11 +160,12 @@ class OutputLayer(DenseLayer):
         return act.apply(self.conf.activation, self.pre_output(params, x))
 
     def loss(self, params: Params, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+        # L2 is NOT added here: regularization lives in the gradient-transform
+        # chain (optimize.transforms.from_conf / weight_decay), matching the
+        # reference where BaseOptimizer post-processes gradients. Adding it in
+        # both places would double-count.
         out = self.activate(params, x)
-        l = losses_mod.score(self.conf.loss, labels, out)
-        if self.conf.use_regularization and self.conf.l2 > 0:
-            l = l + 0.5 * self.conf.l2 * jnp.sum(params[W] ** 2)
-        return l
+        return losses_mod.score(self.conf.loss, labels, out)
 
     def label_probabilities(self, params: Params, x: jnp.ndarray) -> jnp.ndarray:
         return self.activate(params, x)
@@ -238,8 +239,7 @@ class AutoEncoder(BasePretrainLayer):
         if self.conf.sparsity > 0 or self.conf.apply_sparsity:
             h = self.encode(params, x)
             l = l + jnp.mean((jnp.mean(h, axis=0) - self.conf.sparsity) ** 2)
-        if self.conf.use_regularization and self.conf.l2 > 0:
-            l = l + 0.5 * self.conf.l2 * jnp.sum(params[W] ** 2)
+        # L2 handled by the transform chain (see OutputLayer.loss note).
         return l
 
     def pretrain_value_and_grad(self, params: Params, x: jnp.ndarray, key):
@@ -360,8 +360,7 @@ class RBM(BasePretrainLayer):
         vb_grad = -jnp.mean(x - nv_sample, axis=0)
         if conf.sparsity > 0 or conf.apply_sparsity:
             hb_grad = hb_grad + (jnp.mean(ph_mean, axis=0) - conf.sparsity)
-        if conf.use_regularization and conf.l2 > 0:
-            w_grad = w_grad + conf.l2 * params[W]
+        # L2 handled by the transform chain (see OutputLayer.loss note).
         grads = {W: w_grad.astype(params[W].dtype),
                  B: hb_grad.astype(params[B].dtype),
                  VBIAS: vb_grad.astype(params[VBIAS].dtype)}

@@ -130,10 +130,7 @@ class MultiLayerNetwork:
                 if rng is not None else [None] * len(self.layers))
         for layer, p, r in zip(self.layers[:-1], params[:-1], rngs[:-1]):
             h = layer.activate(p, h, rng=r, train=train)
-        if isinstance(out_layer, OutputLayer):
-            return out_layer.loss(params[-1], h, labels)
-        # non-classifier tail (e.g. LSTM sequence head)
-        if hasattr(out_layer, "loss"):
+        if hasattr(out_layer, "loss"):  # OutputLayer, LSTM, or any loss-bearing tail
             return out_layer.loss(params[-1], h, labels)
         raise TypeError(f"final layer {type(out_layer).__name__} has no loss")
 
@@ -166,7 +163,7 @@ class MultiLayerNetwork:
                 x = jnp.asarray(batch.features)
                 # inputs to layer i are fixed while layer i trains
                 inp = self._forward_to(i, x)
-                for it in range(conf.num_iterations):
+                for it in range(max(1, conf.num_iterations)):
                     key, sub = jax.random.split(key)
                     lparams, tstate, loss = step(lparams, tstate, inp, sub,
                                                  jnp.asarray(it))
@@ -224,21 +221,24 @@ class MultiLayerNetwork:
         out_conf = self.layers[-1].conf
         transform = tfm.from_conf(out_conf)
         step = self._train_step(transform)
-        params = self.params
         tstate = (self._tstates if self._tstates is not None
-                  else transform.init(params))
+                  else transform.init(self.params))
         it = 0
         for batch in batches:
             x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
             for _ in range(max(1, out_conf.num_iterations)):
                 key, sub = jax.random.split(key)
-                params, tstate, loss = step(params, tstate, x, y, sub, jnp.asarray(it))
+                # Rebind self.params/self._tstates IMMEDIATELY: the step
+                # donates its inputs, so the previous buffers are dead the
+                # moment it returns — listeners (which may call output())
+                # and crash recovery must see the fresh ones.
+                self.params, tstate, loss = step(
+                    self.params, tstate, x, y, sub, jnp.asarray(it))
+                self._tstates = tstate
                 it += 1
                 self._score = float(loss)
                 for l in self.listeners:
                     l.iteration_done(self, it)
-        self.params = params
-        self._tstates = tstate
 
     def _train_step(self, transform):
         fn = self._jit_cache.get("train_step")
@@ -272,10 +272,13 @@ class MultiLayerNetwork:
     def fit(self, data_or_iter, key=None) -> "MultiLayerNetwork":
         """``fit = pretrain + finetune`` (``fit:985-1022``)."""
         self._ensure_init()
+        k_pre = k_fine = None
+        if key is not None:
+            k_pre, k_fine = jax.random.split(key)
         if self.conf.pretrain:
-            self.pretrain(data_or_iter, key)
+            self.pretrain(data_or_iter, k_pre)
         if self.conf.backprop:
-            self.finetune(data_or_iter, key)
+            self.finetune(data_or_iter, k_fine)
         return self
 
     def fit_arrays(self, features, labels_or_idx, key=None) -> "MultiLayerNetwork":

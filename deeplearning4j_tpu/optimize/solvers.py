@@ -102,6 +102,8 @@ class BaseOptimizer:
         self.training_evaluator = training_evaluator
         self._score = float("inf")
         self._jit_obj = jax.jit(objective)
+        # value-only objective for line-search probes (no wasted backward pass)
+        self._jit_val = jax.jit(lambda p, k: objective(p, k)[0])
 
     def score(self) -> float:
         return self._score
@@ -131,7 +133,7 @@ class BaseOptimizer:
             direction, state = self.direction(params, grads, state)
             if self.use_line_search:
                 ls = BackTrackLineSearch(
-                    lambda p, s=sub: self.objective(p, s)[0])
+                    lambda p, s=sub: self._jit_val(p, s))
                 step = ls.optimize(params, direction, grads, initial_step=1.0)
                 params = tm.axpy(step, direction, params)
             else:

@@ -1,0 +1,28 @@
+"""L5-L7 — distributed training, TPU-native.
+
+The reference's scaleout stack (Akka actors + Hazelcast blackboard + YARN
+supersteps + ZooKeeper config, SURVEY.md §2.3) collapses on TPU into:
+
+- **SPMD compute plane** (``mesh``, ``collectives``, ``trainer``): one jitted
+  train step sharded over a `jax.sharding.Mesh`; parameter averaging ≡ the
+  gradient `pmean` XLA inserts for sharded-batch/replicated-param layouts,
+  riding ICI/DCN — replacing IterativeReduceWorkRouter + INDArrayAggregator +
+  Hazelcast replication wholesale.
+- **host control plane** (``scaleout``): Job/WorkerPerformer/StateTracker/
+  WorkRouter capability parity for orchestration-level workloads (the
+  reference's embedding trainers, grid jobs), including heartbeats,
+  stale-worker eviction, and job re-routing — in-process threads instead of
+  an actor cluster, with ``jax.distributed`` bootstrap for real multi-host.
+- **checkpoint/resume** (``checkpoint``): params + optimizer state + data
+  cursor (exceeds the reference, which only java-serializes params).
+"""
+
+from .mesh import MeshSpec, local_mesh, make_mesh
+from .trainer import DataParallelTrainer, TrainState
+from .checkpoint import CheckpointManager
+
+__all__ = [
+    "MeshSpec", "local_mesh", "make_mesh",
+    "DataParallelTrainer", "TrainState",
+    "CheckpointManager",
+]

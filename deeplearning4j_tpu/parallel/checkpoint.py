@@ -1,0 +1,126 @@
+"""Checkpoint / resume.
+
+Exceeds the reference (SURVEY.md §5.4: java-serialized params only, no
+optimizer state or data cursor — ``DefaultModelSaver``,
+``ModelSavingActor.java:75-79``): checkpoints carry params + optimizer
+(transform) state + step counter + RNG key + data cursor, with keep-last-N
+rotation and atomic writes.  Storage is a directory of npz payloads + JSON
+metadata — host-side, mesh-agnostic (arrays are gathered to host before
+write; on restore the trainer re-places them onto its mesh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(p) for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _restore_like(template, arrays: dict[str, np.ndarray]):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        key = "/".join(str(p) for p in path)
+        if key not in arrays:
+            raise KeyError(f"checkpoint missing leaf {key}")
+        arr = arrays[key]
+        leaves.append(jnp.asarray(arr) if isinstance(leaf, (jnp.ndarray, np.ndarray))
+                      else type(leaf)(arr.item()))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class CheckpointManager:
+    """Keep-last-N rotating checkpoints under a directory."""
+
+    def __init__(self, directory: str | Path, keep: int = 3):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, params, tstate=None, key=None,
+             data_cursor: int = 0, extra: dict | None = None) -> Path:
+        ckpt_dir = self.directory / f"ckpt_{step:010d}"
+        tmp = Path(tempfile.mkdtemp(dir=self.directory))
+        try:
+            np.savez(tmp / "params.npz", **_flatten_with_paths(params))
+            if tstate is not None:
+                np.savez(tmp / "tstate.npz", **_flatten_with_paths(tstate))
+            meta = {
+                "step": step,
+                "data_cursor": data_cursor,
+                "has_tstate": tstate is not None,
+                "has_key": key is not None,
+                "extra": extra or {},
+            }
+            if key is not None:
+                np.save(tmp / "key.npy", np.asarray(jax.random.key_data(key)))
+            (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+            if ckpt_dir.exists():
+                shutil.rmtree(ckpt_dir)
+            os.replace(tmp, ckpt_dir)  # atomic publish
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._rotate()
+        return ckpt_dir
+
+    def _rotate(self):
+        ckpts = self.all_steps()
+        for step in ckpts[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(self.directory / f"ckpt_{step:010d}", ignore_errors=True)
+
+    # ------------------------------------------------------------------ load
+    def all_steps(self) -> list[int]:
+        steps = []
+        for p in self.directory.glob("ckpt_*"):
+            try:
+                steps.append(int(p.name.split("_")[1]))
+            except (IndexError, ValueError):
+                continue
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, params_template, tstate_template=None,
+                step: int | None = None) -> dict:
+        """Returns dict(step, params, tstate, key, data_cursor, extra)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        ckpt_dir = self.directory / f"ckpt_{step:010d}"
+        meta = json.loads((ckpt_dir / "meta.json").read_text())
+        params_npz = np.load(ckpt_dir / "params.npz")
+        params = _restore_like(params_template, dict(params_npz))
+        tstate = None
+        if meta["has_tstate"] and tstate_template is not None:
+            tstate = _restore_like(tstate_template, dict(np.load(ckpt_dir / "tstate.npz")))
+        key = None
+        if meta["has_key"]:
+            key = jax.random.wrap_key_data(jnp.asarray(np.load(ckpt_dir / "key.npy")))
+        return {
+            "step": meta["step"],
+            "params": params,
+            "tstate": tstate,
+            "key": key,
+            "data_cursor": meta["data_cursor"],
+            "extra": meta["extra"],
+        }

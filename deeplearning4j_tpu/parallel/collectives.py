@@ -1,0 +1,59 @@
+"""The collectives layer — the reference's "NCCL"/transport, TPU-native.
+
+The reference moves parameters/updates through Akka remote messages +
+Hazelcast IMaps/ILists + Avro RPC (SURVEY.md §2.3 backend table).  On TPU the
+entire data plane is XLA collectives compiled into the step function and
+riding ICI (intra-slice) / DCN (inter-slice).  These wrappers name that
+surface explicitly — use them inside ``shard_map``-ped functions; under plain
+``pjit`` sharding propagation inserts the same collectives automatically.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def psum(x, axis: str):
+    """All-reduce sum over a mesh axis (≡ parameter-averaging numerator,
+    ``INDArrayAggregator.accumulate``)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    """All-reduce mean (≡ ``IterativeReduceWorkRouter`` averaging in one op)."""
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = False):
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    """Neighbor exchange — the ring primitive under ring attention /
+    pipeline micro-batch handoff."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: str, axis_size: int, shift: int = 1):
+    """Shift values around the ring by ``shift`` positions."""
+    perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def barrier_sum(axis: str):
+    """Cheap cross-device barrier: psum of a scalar 1 (control-plane sync;
+    replaces the reference's 'wait for N worker updates' poll loop)."""
+    return lax.psum(jnp.ones(()), axis)

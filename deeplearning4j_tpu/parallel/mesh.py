@@ -1,0 +1,101 @@
+"""Device-mesh construction and multi-host bootstrap.
+
+TPU-native replacement for the reference's cluster formation
+(``DeepLearning4jDistributed.java:128-187`` Akka seed join +
+``BaseHazelCastStateTracker.java:454-539`` embedded-vs-client): topology is a
+`jax.sharding.Mesh` with named axes, and multi-host bootstrap is
+``jax.distributed.initialize`` (the JAX coordination service) — one program,
+no master/worker asymmetry.
+
+Axis convention (used across trainers/models):
+``dp`` data, ``tp`` tensor/model, ``pp`` pipeline stages, ``sp`` sequence
+(ring attention / context parallel), ``ep`` expert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named mesh shape; -1 on one axis means 'absorb remaining devices'."""
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {DP: self.dp, TP: self.tp, PP: self.pp, SP: self.sp, EP: self.ep}
+        wild = [k for k, v in sizes.items() if v == -1]
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if len(wild) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec | None = None, devices: Sequence | None = None) -> Mesh:
+    """Build a named mesh over the given (default: all) devices.
+
+    Axis order is (pp, dp, sp, tp, ep) — tp innermost so tensor-parallel
+    collectives ride the fastest ICI links; pp outermost so pipeline stages
+    can span slower (DCN) boundaries.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(len(devices))
+    order = (PP, DP, SP, TP, EP)
+    shape = tuple(sizes[a] for a in order)
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, order)
+
+
+def local_mesh(n: int | None = None, axis: str = DP) -> Mesh:
+    """1-axis mesh over local devices (the common data-parallel case)."""
+    devices = jax.devices()[: (n or len(jax.devices()))]
+    return Mesh(np.array(devices), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DP, ndim: int = 2) -> NamedSharding:
+    """Shard the leading (batch) dim over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def initialize_multihost(coordinator: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> None:
+    """Multi-host bootstrap (replaces Akka-seed/ZooKeeper discovery).
+
+    No-op when single-process.  Env-var driven (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) like the reference's
+    Hadoop-style ``Configuration`` keys.
+    """
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes or os.environ.get("JAX_NUM_PROCESSES", 1)),
+        process_id=int(process_id or os.environ.get("JAX_PROCESS_ID", 0)),
+    )

@@ -1,0 +1,458 @@
+"""Host-side scaleout control plane: jobs, performers, state tracking,
+routing, and an in-process master/worker runtime.
+
+Capability parity with the reference's L5/L6 (SURVEY.md §2.3-2.4):
+
+- ``Job``/``JobIterator`` (``scaleout/job/*.java``) — serializable work units
+- ``WorkerPerformer`` SPI (``scaleout/perform/WorkerPerformer.java``)
+- ``JobAggregator`` (``scaleout/aggregator/JobAggregator.java``) with the
+  running-average ``ArrayAggregator`` (≡ ``INDArrayAggregator``)
+- ``StateTracker`` (``scaleout/api/statetracker/StateTracker.java`` ~40-method
+  blackboard): workers, heartbeats, jobs, updates, counters, current-model
+  replication — an in-process, thread-safe dict replacing Hazelcast
+- ``WorkRouter`` policies: ``IterativeReduceWorkRouter`` (dispatch only when
+  all workers reported) vs ``HogWildWorkRouter`` (always dispatch)
+- ``DistributedRunner`` (``DeepLearning4jDistributed.java``): master loop +
+  worker threads with 1 s heartbeats, 120 s stale eviction
+  (``MasterActor.java:123-153``), job re-routing, ``ModelSaver`` hooks.
+
+Why threads, not actors: on TPU pods the *data plane* is SPMD collectives
+(``trainer.py``); what remains for a control plane is exactly what fits in
+one coordinator process (JAX single-controller model).  The SPI surface is
+kept so orchestration-level workloads (sharded embedding training, grid
+jobs) and the reference's test pattern ("distributed-without-a-cluster",
+``BaseTestDistributed``) port over directly.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Iterator, Protocol, Sequence
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------- jobs
+
+@dataclass
+class Job:
+    """Serializable work unit (``scaleout/job/Job.java``)."""
+
+    work: Any
+    worker_id: str = ""
+    result: Any = None
+
+
+class JobIterator(Protocol):
+    """``scaleout/job/JobIterator.java``."""
+
+    def next(self, worker_id: str = "") -> Job: ...
+    def has_next(self) -> bool: ...
+    def reset(self) -> None: ...
+
+
+class CollectionJobIterator:
+    """``scaleout/job/collection/CollectionJobIterator.java``."""
+
+    def __init__(self, items: Sequence[Any]):
+        self.items = list(items)
+        self._i = 0
+
+    def next(self, worker_id: str = "") -> Job:
+        job = Job(work=self.items[self._i], worker_id=worker_id)
+        self._i += 1
+        return job
+
+    def has_next(self) -> bool:
+        return self._i < len(self.items)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class DataSetJobIterator:
+    """Wrap a DataSetIterator as a job stream (``JobIterator`` over batches)."""
+
+    def __init__(self, it):
+        self._it = it
+
+    def next(self, worker_id: str = "") -> Job:
+        return Job(work=self._it.next(), worker_id=worker_id)
+
+    def has_next(self) -> bool:
+        return self._it.has_next()
+
+    def reset(self) -> None:
+        self._it.reset()
+
+
+# --------------------------------------------------------------------------- SPI
+
+class WorkerPerformer(Protocol):
+    """``scaleout/perform/WorkerPerformer.java``: do the work, then push
+    updates through ``update``."""
+
+    def perform(self, job: Job) -> None: ...
+    def update(self, *args: Any) -> None: ...
+
+
+class JobAggregator(Protocol):
+    """``scaleout/aggregator/JobAggregator.java``."""
+
+    def accumulate(self, job: Job) -> None: ...
+    def aggregate(self) -> Any: ...
+
+
+class ArrayAggregator:
+    """Running average of pytree/array results (``INDArrayAggregator``:
+    accumulate sum, divide by count on aggregate)."""
+
+    def __init__(self):
+        self._sum = None
+        self._count = 0
+
+    def accumulate(self, job: Job) -> None:
+        import jax
+        if job.result is None:
+            return
+        if self._sum is None:
+            self._sum = jax.tree_util.tree_map(np.asarray, job.result)
+        else:
+            self._sum = jax.tree_util.tree_map(
+                lambda a, b: a + np.asarray(b), self._sum, job.result)
+        self._count += 1
+
+    def aggregate(self) -> Any:
+        import jax
+        if self._sum is None:
+            return None
+        return jax.tree_util.tree_map(lambda a: a / self._count, self._sum)
+
+
+# --------------------------------------------------------------------------- state tracker
+
+class StateTracker:
+    """In-process cluster blackboard (Hazelcast ``BaseHazelCastStateTracker``
+    capability: workers/jobs/updates/heartbeats/counters/current-model).
+    Thread-safe; all mutation under one lock (operations are tiny)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._workers: set[str] = set()
+        self._enabled: dict[str, bool] = {}
+        self._heartbeats: dict[str, float] = {}
+        self._jobs: dict[str, Job] = {}          # worker -> current job
+        self._updates: dict[str, Any] = {}       # worker -> latest update
+        self._counters: dict[str, float] = defaultdict(float)
+        self._current: Any = None                # current global model/params
+        self._needs_replicate: set[str] = set()
+        self._done = False
+        self._saved_workers: dict[str, Job] = {} # job persistence for re-retrieval
+        self.update_listeners: list[Callable[[Any], None]] = []
+
+    # -- workers --------------------------------------------------------
+    def add_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.add(worker_id)
+            self._enabled[worker_id] = True
+            self._heartbeats[worker_id] = time.time()
+
+    def remove_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.discard(worker_id)
+            self._enabled.pop(worker_id, None)
+            self._heartbeats.pop(worker_id, None)
+            self._jobs.pop(worker_id, None)
+
+    def workers(self) -> list[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    def enable_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._enabled[worker_id] = True
+
+    def disable_worker(self, worker_id: str) -> None:
+        with self._lock:
+            self._enabled[worker_id] = False
+
+    def is_enabled(self, worker_id: str) -> bool:
+        with self._lock:
+            return self._enabled.get(worker_id, False)
+
+    # -- heartbeats / failure detection ---------------------------------
+    def heartbeat(self, worker_id: str) -> None:
+        with self._lock:
+            self._heartbeats[worker_id] = time.time()
+
+    def last_heartbeat(self, worker_id: str) -> float:
+        with self._lock:
+            return self._heartbeats.get(worker_id, 0.0)
+
+    def evict_stale(self, timeout_s: float = 120.0) -> list[str]:
+        """Master-side eviction sweep (``MasterActor.java:123-153``)."""
+        now = time.time()
+        evicted = []
+        with self._lock:
+            for w in list(self._workers):
+                if now - self._heartbeats.get(w, 0) > timeout_s:
+                    evicted.append(w)
+                    self.remove_worker(w)
+        return evicted
+
+    # -- jobs -----------------------------------------------------------
+    def add_job(self, job: Job) -> None:
+        with self._lock:
+            self._jobs[job.worker_id] = job
+            self._saved_workers[job.worker_id] = job
+
+    def job_for(self, worker_id: str) -> Job | None:
+        with self._lock:
+            return self._jobs.get(worker_id)
+
+    def clear_job(self, worker_id: str) -> None:
+        with self._lock:
+            self._jobs.pop(worker_id, None)
+
+    def current_jobs(self) -> list[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def load_for_worker(self, worker_id: str) -> Job | None:
+        """Job re-retrieval after worker restart (``WorkRetriever``)."""
+        with self._lock:
+            return self._saved_workers.get(worker_id)
+
+    # -- updates --------------------------------------------------------
+    def add_update(self, worker_id: str, update: Any) -> None:
+        with self._lock:
+            self._updates[worker_id] = update
+            listeners = list(self.update_listeners)
+        for l in listeners:
+            l(update)
+
+    def updates(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._updates)
+
+    def clear_updates(self) -> None:
+        with self._lock:
+            self._updates.clear()
+
+    # -- counters (distributed words-seen etc.) -------------------------
+    def increment(self, key: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[key] += by
+
+    def count(self, key: str) -> float:
+        with self._lock:
+            return self._counters[key]
+
+    # -- current model / replication ------------------------------------
+    def set_current(self, value: Any) -> None:
+        with self._lock:
+            self._current = value
+            self._needs_replicate = set(self._workers)
+
+    def get_current(self) -> Any:
+        with self._lock:
+            return self._current
+
+    def add_replicate(self, worker_id: str) -> None:
+        with self._lock:
+            self._needs_replicate.add(worker_id)
+
+    def needs_replicate(self, worker_id: str) -> bool:
+        with self._lock:
+            return worker_id in self._needs_replicate
+
+    def done_replicating(self, worker_id: str) -> None:
+        with self._lock:
+            self._needs_replicate.discard(worker_id)
+
+    # -- lifecycle ------------------------------------------------------
+    def finish(self) -> None:
+        with self._lock:
+            self._done = True
+
+    def is_done(self) -> bool:
+        with self._lock:
+            return self._done
+
+
+# --------------------------------------------------------------------------- routers
+
+class WorkRouter:
+    """Dispatch/merge policy (``api/workrouter/WorkRouter.java`` +
+    ``BaseWorkRouter.java``)."""
+
+    def __init__(self, tracker: StateTracker, aggregator_factory=ArrayAggregator):
+        self.tracker = tracker
+        self.aggregator_factory = aggregator_factory
+
+    def send_work(self) -> bool:
+        raise NotImplementedError
+
+    def update(self) -> None:
+        """Aggregate worker updates into the new current model
+        (``BaseWorkRouter.update`` → ``IterateAndUpdateImpl``)."""
+        updates = self.tracker.updates()
+        if not updates:
+            return
+        agg = self.aggregator_factory()
+        for wid, upd in updates.items():
+            agg.accumulate(Job(work=None, worker_id=wid, result=upd))
+        merged = agg.aggregate()
+        if merged is not None:
+            self.tracker.set_current(merged)
+        self.tracker.clear_updates()
+
+
+class IterativeReduceWorkRouter(WorkRouter):
+    """Synchronous parameter averaging: only dispatch the next wave after
+    every live worker has reported (``IterativeReduceWorkRouter.java:30``)."""
+
+    def send_work(self) -> bool:
+        n_workers = len(self.tracker.workers())
+        return n_workers > 0 and len(self.tracker.updates()) >= n_workers
+
+
+class HogWildWorkRouter(WorkRouter):
+    """Asynchronous: always dispatch (``HogWildWorkRouter.java``)."""
+
+    def send_work(self) -> bool:
+        return True
+
+
+# --------------------------------------------------------------------------- model saving
+
+class ModelSaver(Protocol):
+    """``actor/core/ModelSaver.java``."""
+
+    def save(self, model: Any) -> None: ...
+    def load(self) -> Any: ...
+
+
+class FileModelSaver:
+    """``DefaultModelSaver`` — pickle to a file, atomic replace."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+
+    def save(self, model: Any) -> None:
+        tmp = self.path.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(model, f)
+        tmp.replace(self.path)
+
+    def load(self) -> Any:
+        with open(self.path, "rb") as f:
+            return pickle.load(f)
+
+
+# --------------------------------------------------------------------------- runner
+
+class DistributedRunner:
+    """In-process master/worker runtime (``DeepLearning4jDistributed`` +
+    ``MasterActor``/``WorkerActor`` loops).
+
+    Workers = threads pulling jobs via the StateTracker, running the
+    WorkerPerformer, heartbeating every ``heartbeat_s``; the master loop
+    polls, applies the WorkRouter policy, re-routes orphaned jobs, and
+    evicts stale workers.  Mirrors the reference's test pattern: the REAL
+    orchestration stack in one process.
+    """
+
+    def __init__(self, job_iterator, performer_factory: Callable[[StateTracker], WorkerPerformer],
+                 n_workers: int = 2, router_cls=IterativeReduceWorkRouter,
+                 tracker: StateTracker | None = None,
+                 model_saver: ModelSaver | None = None,
+                 heartbeat_s: float = 0.05, poll_s: float = 0.02,
+                 eviction_timeout_s: float = 120.0):
+        self.job_iterator = job_iterator
+        self.performer_factory = performer_factory
+        self.n_workers = n_workers
+        self.tracker = tracker or StateTracker()
+        self.router = router_cls(self.tracker)
+        self.model_saver = model_saver
+        self.heartbeat_s = heartbeat_s
+        self.poll_s = poll_s
+        self.eviction_timeout_s = eviction_timeout_s
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- worker loop ----------------------------------------------------
+    def _worker_loop(self, worker_id: str):
+        performer = self.performer_factory(self.tracker)
+        while not self._stop.is_set() and not self.tracker.is_done():
+            self.tracker.heartbeat(worker_id)
+            if not self.tracker.is_enabled(worker_id):
+                time.sleep(self.heartbeat_s)
+                continue
+            if self.tracker.needs_replicate(worker_id):
+                current = self.tracker.get_current()
+                if current is not None:
+                    performer.update(current)
+                self.tracker.done_replicating(worker_id)
+            job = self.tracker.job_for(worker_id)
+            if job is None:
+                time.sleep(self.poll_s)
+                continue
+            performer.perform(job)
+            if job.result is not None:
+                self.tracker.add_update(worker_id, job.result)
+            self.tracker.clear_job(worker_id)
+
+    # -- master loop ----------------------------------------------------
+    def run(self, max_wall_s: float = 300.0) -> Any:
+        for i in range(self.n_workers):
+            wid = f"worker-{i}"
+            self.tracker.add_worker(wid)
+            t = threading.Thread(target=self._worker_loop, args=(wid,), daemon=True)
+            self._threads.append(t)
+            t.start()
+        deadline = time.time() + max_wall_s
+        last_evict = time.time()
+        try:
+            while time.time() < deadline:
+                # eviction sweep (reference: every 60 s; scaled to poll rate)
+                if time.time() - last_evict > max(1.0, self.eviction_timeout_s / 2):
+                    self.tracker.evict_stale(self.eviction_timeout_s)
+                    last_evict = time.time()
+                if self.router.send_work():
+                    self.router.update()
+                    if self.model_saver is not None:
+                        current = self.tracker.get_current()
+                        if current is not None:
+                            self.model_saver.save(current)
+                # dispatch to idle workers
+                dispatched = False
+                for wid in self.tracker.workers():
+                    if self.tracker.job_for(wid) is None and self.job_iterator.has_next():
+                        job = self.job_iterator.next(wid)
+                        job.worker_id = wid
+                        self.tracker.add_job(job)
+                        dispatched = True
+                if (not self.job_iterator.has_next()
+                        and not self.tracker.current_jobs()
+                        and not dispatched):
+                    # drain final updates
+                    if self.tracker.updates():
+                        self.router.update()
+                        if self.model_saver is not None:
+                            current = self.tracker.get_current()
+                            if current is not None:
+                                self.model_saver.save(current)
+                    self.tracker.finish()
+                    break
+                time.sleep(self.poll_s)
+        finally:
+            self._stop.set()
+            for t in self._threads:
+                t.join(timeout=5.0)
+        return self.tracker.get_current()

@@ -1,0 +1,145 @@
+"""Scaleout control-plane tests — mirror of the reference's
+``BaseTestDistributed`` pattern: boot the REAL orchestration stack
+(tracker + router + master loop + worker threads) in one process with a
+pluggable performer; ``NoOpPerformer`` tests orchestration alone
+(``TestPerformer.java``), then a real parameter-averaging run."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.scaleout import (
+    ArrayAggregator,
+    CollectionJobIterator,
+    DistributedRunner,
+    FileModelSaver,
+    HogWildWorkRouter,
+    IterativeReduceWorkRouter,
+    Job,
+    StateTracker,
+)
+
+
+class NoOpPerformer:
+    """``TestPerformer.java`` — records jobs, produces no updates."""
+
+    performed = []
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def perform(self, job):
+        NoOpPerformer.performed.append(job.work)
+
+    def update(self, *args):
+        pass
+
+
+class AveragingPerformer:
+    """Produce the work array as the 'trained params' update; master
+    averages them (parameter-averaging superstep in miniature)."""
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+        self.received_model = None
+
+    def perform(self, job):
+        job.result = np.asarray(job.work, dtype=np.float64)
+
+    def update(self, current):
+        self.received_model = current
+
+
+def test_state_tracker_basics():
+    t = StateTracker()
+    t.add_worker("w0")
+    t.add_worker("w1")
+    assert t.workers() == ["w0", "w1"]
+    t.disable_worker("w1")
+    assert t.is_enabled("w0") and not t.is_enabled("w1")
+    t.add_job(Job(work=1, worker_id="w0"))
+    assert t.job_for("w0").work == 1
+    assert t.load_for_worker("w0").work == 1  # persisted for re-retrieval
+    t.clear_job("w0")
+    assert t.job_for("w0") is None
+    t.increment("words", 10)
+    t.increment("words", 5)
+    assert t.count("words") == 15
+    t.add_update("w0", np.ones(3))
+    assert "w0" in t.updates()
+
+
+def test_heartbeat_eviction():
+    t = StateTracker()
+    t.add_worker("alive")
+    t.add_worker("dead")
+    t._heartbeats["dead"] = time.time() - 1000
+    evicted = t.evict_stale(timeout_s=120)
+    assert evicted == ["dead"]
+    assert t.workers() == ["alive"]
+
+
+def test_update_listener_fires():
+    t = StateTracker()
+    seen = []
+    t.update_listeners.append(seen.append)
+    t.add_update("w0", 42)
+    assert seen == [42]
+
+
+def test_array_aggregator_running_average():
+    agg = ArrayAggregator()
+    agg.accumulate(Job(work=None, result=np.array([2.0, 4.0])))
+    agg.accumulate(Job(work=None, result=np.array([4.0, 8.0])))
+    np.testing.assert_allclose(agg.aggregate(), [3.0, 6.0])
+
+
+def test_routers_policy():
+    t = StateTracker()
+    t.add_worker("w0")
+    t.add_worker("w1")
+    ir = IterativeReduceWorkRouter(t)
+    hw = HogWildWorkRouter(t)
+    assert hw.send_work()
+    assert not ir.send_work()          # no updates yet
+    t.add_update("w0", np.ones(2))
+    assert not ir.send_work()          # 1 of 2
+    t.add_update("w1", np.ones(2))
+    assert ir.send_work()              # all reported
+
+
+def test_runner_orchestration_noop():
+    NoOpPerformer.performed = []
+    runner = DistributedRunner(
+        CollectionJobIterator(list(range(20))), NoOpPerformer, n_workers=3)
+    runner.run(max_wall_s=30)
+    assert sorted(NoOpPerformer.performed) == list(range(20))
+    assert runner.tracker.is_done()
+
+
+def test_runner_parameter_averaging(tmp_path):
+    """End-to-end superstep: workers 'train' (echo arrays), master averages
+    via IterativeReduce policy and persists via ModelSaver."""
+    items = [np.full(4, float(i)) for i in range(8)]
+    saver = FileModelSaver(tmp_path / "model.bin")
+    runner = DistributedRunner(
+        CollectionJobIterator(items), AveragingPerformer, n_workers=2,
+        router_cls=IterativeReduceWorkRouter, model_saver=saver)
+    result = runner.run(max_wall_s=30)
+    assert result is not None and result.shape == (4,)
+    # final current model is an average of (subsets of) the items
+    assert 0.0 <= float(result[0]) <= 7.0
+    loaded = saver.load()
+    np.testing.assert_allclose(loaded, result)
+
+
+def test_runner_hogwild_always_dispatches():
+    items = [np.ones(2) * i for i in range(6)]
+    runner = DistributedRunner(
+        CollectionJobIterator(items), AveragingPerformer, n_workers=2,
+        router_cls=HogWildWorkRouter)
+    result = runner.run(max_wall_s=30)
+    assert runner.tracker.is_done()
+    assert result is not None
