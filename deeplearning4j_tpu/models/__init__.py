@@ -1,0 +1,14 @@
+"""Model zoo.
+
+Reference-parity families (SURVEY.md §2.2): MLP/DBN and LeNet-style conv
+nets are built from ``nn`` configs (see ``zoo.py``); LSTM classifier with
+beam search in ``lstm.py``; the NLP embedding models live in ``..text``.
+
+Beyond-v0 north-star families (BASELINE.json configs): ``transformer.py`` —
+a BERT/GPT-class encoder with explicit SPMD sharding (dp/tp/sp with ring
+attention) — and ``resnet.py``.
+"""
+
+from .transformer import TransformerConfig, TransformerLM
+
+__all__ = ["TransformerConfig", "TransformerLM"]

@@ -1,0 +1,409 @@
+"""Transformer LM/encoder — the flagship model, explicit-SPMD edition.
+
+Role in the framework (BASELINE.json north star: BERT-base fine-tune at
+≥35% MFU on v5e): the reference has no attention models (SURVEY.md §5.7 —
+sequence handling tops out at LSTM BPTT), but its *capability obligation* at
+modern scale is long-sequence training sharded over a pod.  This module is
+the TPU-first design for that: ONE train step, manually sharded with
+``shard_map`` over a (dp, sp, tp) mesh, every collective explicit:
+
+- **dp** data parallel: batch sharded; gradient `pmean` after backward.
+- **tp** tensor parallel (Megatron-style): attention heads and FFN hidden
+  sharded; one `psum` after the attention output projection and one after
+  FFN's second matmul (forward); autodiff transposes them into the matching
+  backward collectives.
+- **sp** sequence/context parallel: sequence sharded; attention runs as
+  **ring attention** — K/V blocks rotate around the ``sp`` ring via
+  `ppermute` with a flash-style running-softmax (log-sum-exp) accumulator,
+  so no device ever materializes the full (T, T) score matrix and sequence
+  length scales with the ring size.
+
+Compute is bfloat16 on the MXU with float32 params/accumulators (the
+softmax statistics and loss reductions stay f32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel.mesh import DP, SP, TP
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_len: int = 2048
+    causal: bool = True              # False = BERT-style bidirectional
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16        # MXU compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True               # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs per token (fwd+bwd ≈ 6*N params +
+        attention term; used for MFU accounting)."""
+        n_params = (self.vocab_size * self.d_model
+                    + self.n_layers * (4 * self.d_model * self.d_model
+                                       + 2 * self.d_model * self.d_ff)
+                    + self.max_len * self.d_model)
+        attn = self.n_layers * 2 * self.max_len * self.d_model  # per-token qk+av
+        return 6.0 * (n_params + attn)
+
+
+# --------------------------------------------------------------------------- params
+
+def init_params(key, cfg: TransformerConfig) -> Params:
+    """Scaled-normal init; qkv packed (D, 3, H, Dh), out proj (H, Dh, D)."""
+    pd = cfg.param_dtype
+    d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    keys = jax.random.split(key, cfg.n_layers + 3)
+
+    def norm(k, shape, scale):
+        return (scale * jax.random.normal(k, shape)).astype(pd)
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[i], 4)
+        layers.append({
+            "ln1_scale": jnp.ones((d,), pd), "ln1_bias": jnp.zeros((d,), pd),
+            "wqkv": norm(lk[0], (d, 3, h, dh), d ** -0.5),
+            "wo": norm(lk[1], (h, dh, d), (h * dh) ** -0.5),
+            "ln2_scale": jnp.ones((d,), pd), "ln2_bias": jnp.zeros((d,), pd),
+            "w1": norm(lk[2], (d, f), d ** -0.5),
+            "b1": jnp.zeros((f,), pd),
+            "w2": norm(lk[3], (f, d), f ** -0.5),
+            "b2": jnp.zeros((d,), pd),
+        })
+    params = {
+        "tok_embed": norm(keys[-3], (cfg.vocab_size, d), 0.02),
+        "pos_embed": norm(keys[-2], (cfg.max_len, d), 0.02),
+        "final_ln_scale": jnp.ones((d,), pd),
+        "final_ln_bias": jnp.zeros((d,), pd),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(keys[-1], (d, cfg.vocab_size), d ** -0.5)
+    return params
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpecs per leaf: heads/ffn-hidden sharded over tp, the rest
+    replicated (sharded-embedding variants come with the ep axis later)."""
+    layer = {
+        "ln1_scale": P(), "ln1_bias": P(),
+        "wqkv": P(None, None, TP, None),
+        "wo": P(TP, None, None),
+        "ln2_scale": P(), "ln2_bias": P(),
+        "w1": P(None, TP), "b1": P(TP),
+        "w2": P(TP, None), "b2": P(),
+    }
+    specs = {
+        "tok_embed": P(), "pos_embed": P(),
+        "final_ln_scale": P(), "final_ln_bias": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P()
+    return specs
+
+
+# --------------------------------------------------------------------------- tp boundary ops
+
+# Megatron-style f/g pair: explicit AD-correct boundaries for the tensor-
+# parallel branch.  Under ``shard_map(check_vma=False)`` plain `psum` has an
+# ambiguous transpose (replicated vs partial cotangents), so each tp branch
+# is entered through ``copy_to_tp`` (identity fwd / psum bwd — collects the
+# per-head/per-ffn-shard cotangent contributions exactly once) and exited
+# through ``reduce_from_tp`` (psum fwd / identity bwd).  With these, local
+# `jax.grad` produces full, replica-identical gradients for replicated
+# params and correct shard-local gradients for tp-sharded params.
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x, axis):
+    return x
+
+
+def _copy_fwd(x, axis):
+    return x, None
+
+
+def _copy_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+copy_to_tp.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp(x, axis):
+    return lax.psum(x, axis)
+
+
+def _reduce_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _reduce_bwd(axis, _, ct):
+    return (ct,)
+
+
+reduce_from_tp.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --------------------------------------------------------------------------- math
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _attend_block(q, k, v, q_pos, k_pos, causal, acc, m, l):
+    """One flash-style block update.
+
+    q: (B, Tq, Hl, Dh); k/v: (B, Tk, Hl, Dh); acc: (B, Tq, Hl, Dh) f32;
+    m/l: (B, Tq, Hl) running max / denominator (f32).
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        mask = q_pos[None, :, None, None] >= k_pos[None, None, None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard rows with no valid keys yet (all -inf)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q, k, v, *, n_sp: int, sp_axis: str | None, causal: bool,
+                   t_local: int):
+    """Blockwise ring attention over the sp axis (Liu et al. style).
+
+    Inside ``shard_map``: each device holds local Q/K/V of t_local tokens;
+    K/V rotate ``n_sp`` times via ``ppermute`` while a running-softmax
+    accumulates — peak memory O(T_local^2) scores, full-sequence semantics.
+    With n_sp == 1 this degenerates to single-block flash attention.
+    """
+    B, Tq, Hl, Dh = q.shape
+    my = lax.axis_index(sp_axis) if sp_axis else 0
+    q_pos = my * t_local + jnp.arange(t_local)
+
+    acc = jnp.zeros((B, Tq, Hl, Dh), jnp.float32)
+    m = jnp.full((B, Tq, Hl), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, Tq, Hl), jnp.float32)
+
+    def body(i, carry):
+        k_blk, v_blk, acc, m, l = carry
+        src = (my - i) % n_sp
+        k_pos = src * t_local + jnp.arange(t_local)
+        acc, m, l = _attend_block(q, k_blk, v_blk, q_pos, k_pos, causal, acc, m, l)
+        if n_sp > 1:
+            perm = [(j, (j + 1) % n_sp) for j in range(n_sp)]
+            k_blk = lax.ppermute(k_blk, sp_axis, perm)
+            v_blk = lax.ppermute(v_blk, sp_axis, perm)
+        return (k_blk, v_blk, acc, m, l)
+
+    if n_sp > 1:
+        # rotate n_sp-1 times; unrolled python loop keeps ppermute count static
+        carry = (k, v, acc, m, l)
+        for i in range(n_sp):
+            carry = body(i, carry)
+        _, _, acc, m, l = carry
+    else:
+        _, _, acc, m, l = body(0, (k, v, acc, m, l))
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
+    """One transformer block, tp/sp-aware (runs inside shard_map)."""
+    dt = cfg.dtype
+    h = _layernorm(x, params["ln1_scale"], params["ln1_bias"])
+    if tp_axis:
+        h = copy_to_tp(h, tp_axis)
+    qkv = jnp.einsum("btd,dshe->btshe", h.astype(dt), params["wqkv"].astype(dt))
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = ring_attention(q, k, v, n_sp=n_sp, sp_axis=sp_axis,
+                          causal=cfg.causal, t_local=t_local)
+    proj = jnp.einsum("bthe,hed->btd", attn.astype(dt), params["wo"].astype(dt))
+    if tp_axis:
+        proj = reduce_from_tp(proj, tp_axis)  # partial sums over local heads
+    x = x + proj.astype(x.dtype)
+
+    h2 = _layernorm(x, params["ln2_scale"], params["ln2_bias"])
+    if tp_axis:
+        h2 = copy_to_tp(h2, tp_axis)
+    u = jnp.einsum("btd,df->btf", h2.astype(dt), params["w1"].astype(dt))
+    u = jax.nn.gelu(u + params["b1"].astype(dt))
+    down = jnp.einsum("btf,fd->btd", u, params["w2"].astype(dt))
+    if tp_axis:
+        down = reduce_from_tp(down, tp_axis)
+    down = down + params["b2"].astype(dt)
+    return x + down.astype(x.dtype)
+
+
+def forward_local(params, tokens, cfg: TransformerConfig, *,
+                  n_sp: int = 1, sp_axis: str | None = None,
+                  tp_axis: str | None = None) -> jnp.ndarray:
+    """Logits for local token shard (B_loc, T_loc) — runs inside shard_map
+    (or standalone when all axes are trivial)."""
+    B, T = tokens.shape
+    my_sp = lax.axis_index(sp_axis) if sp_axis else 0
+    pos0 = my_sp * T
+    x = jnp.take(params["tok_embed"], tokens, axis=0)
+    pos = lax.dynamic_slice_in_dim(params["pos_embed"], pos0, T, axis=0)
+    x = (x + pos[None]).astype(cfg.dtype)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
+    for lp in params["layers"]:
+        x = block(lp, x, cfg, n_sp, sp_axis, tp_axis, T)
+
+    x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", x.astype(cfg.dtype), head.astype(cfg.dtype))
+    return logits.astype(jnp.float32)
+
+
+def lm_loss_local(params, tokens, targets, cfg: TransformerConfig, **axes):
+    """Mean next-token (or MLM-style given targets) cross entropy on the
+    local shard; caller pmean's across dp/sp."""
+    logits = forward_local(params, tokens, cfg, **axes)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+# --------------------------------------------------------------------------- model facade
+
+class TransformerLM:
+    """Flagship trainer: explicit-SPMD train step over a (dp, sp, tp) mesh."""
+
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh | None = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self._train_step = None
+        self._fwd = None
+
+    # -- single-device --------------------------------------------------
+    def init(self, key=None) -> Params:
+        return init_params(key if key is not None else jax.random.key(0), self.cfg)
+
+    def forward(self, params, tokens) -> jnp.ndarray:
+        if self._fwd is None:
+            self._fwd = jax.jit(partial(forward_local, cfg=self.cfg))
+        return self._fwd(params, tokens)
+
+    # -- sharded train step --------------------------------------------
+    def _axes(self):
+        if self.mesh is None:
+            return 1, 1, 1
+        s = self.mesh.shape
+        return s.get(DP, 1), s.get(SP, 1), s.get(TP, 1)
+
+    def build_train_step(self, lr: float = 1e-3):
+        """SGD-with-momentum train step, fully sharded.  Returns
+        ``step(params, mom, tokens, targets) -> (params, mom, loss)``;
+        for mesh=None a plain jitted single-device step."""
+        cfg = self.cfg
+        n_dp, n_sp, n_tp = self._axes()
+        mu = 0.9
+
+        if self.mesh is None:
+            def simple(params, mom, tokens, targets):
+                loss, g = jax.value_and_grad(
+                    lambda p: lm_loss_local(p, tokens, targets, cfg))(params)
+                mom2 = jax.tree_util.tree_map(lambda m, gg: mu * m + gg, mom, g)
+                params = jax.tree_util.tree_map(
+                    lambda p, m: p - lr * m.astype(p.dtype), params, mom2)
+                return params, mom2, loss
+            return jax.jit(simple, donate_argnums=(0, 1))
+
+        specs = param_specs(cfg)
+        data_spec = P(DP, SP)
+        sp_axis = SP if n_sp > 1 else None
+        tp_axis = TP if n_tp > 1 else None
+
+        def local_step(params, mom, tokens, targets):
+            def loss_fn(p):
+                return lm_loss_local(p, tokens, targets, cfg,
+                                     n_sp=n_sp, sp_axis=sp_axis, tp_axis=tp_axis)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            # cross-replica reductions: loss everywhere; grads over the axes
+            # each param is REPLICATED on (dp+sp always; tp too for
+            # tp-replicated leaves).
+            loss = lax.pmean(lax.pmean(loss, DP), SP) if sp_axis else lax.pmean(loss, DP)
+
+            def sync(g, spec):
+                g = lax.pmean(g, DP)
+                if sp_axis:
+                    g = lax.pmean(g, SP)
+                sharded_on_tp = any(ax == TP for ax in spec if ax is not None)
+                if tp_axis and not sharded_on_tp:
+                    g = lax.pmean(g, TP)
+                return g
+
+            grads = jax.tree_util.tree_map(
+                sync, grads, specs,
+                is_leaf=lambda x: isinstance(x, P))
+            mom2 = jax.tree_util.tree_map(lambda m, g: mu * m + g, mom, grads)
+            params = jax.tree_util.tree_map(
+                lambda p, m: p - lr * m.astype(p.dtype), params, mom2)
+            return params, mom2, loss
+
+        smapped = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(specs, specs, data_spec, data_spec),
+            out_specs=(specs, specs, P()),
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1))
+
+    def place(self, tree, specs=None):
+        """Device-put a pytree onto the mesh per param_specs."""
+        if self.mesh is None:
+            return tree
+        specs = specs if specs is not None else param_specs(self.cfg)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            tree, specs)
+
+    def init_momentum(self, params):
+        return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)

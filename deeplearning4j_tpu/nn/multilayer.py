@@ -37,7 +37,6 @@ import numpy as np
 from ..datasets.dataset import DataSet, to_outcome_matrix
 from ..evaluation import Evaluation
 from ..optimize import transforms as tfm
-from ..optimize.solvers import Solver
 from ..utils import tree_math as tm
 from .conf import LayerKind, MultiLayerConfiguration, OptimizationAlgorithm
 from .layers import (
@@ -254,6 +253,7 @@ class MultiLayerNetwork:
         return fn
 
     def _finetune_solver(self, batches: Sequence[DataSet], key, algo) -> None:
+        from ..optimize.solvers import Solver  # deferred: avoids import cycle
         data = DataSet.merge(list(batches))
         x, y = jnp.asarray(data.features), jnp.asarray(data.labels)
 

@@ -102,8 +102,16 @@ class BaseOptimizer:
         self.training_evaluator = training_evaluator
         self._score = float("inf")
         self._jit_obj = jax.jit(objective)
-        # value-only objective for line-search probes (no wasted backward pass)
-        self._jit_val = jax.jit(lambda p, k: objective(p, k)[0])
+        # Value-only objective for line-search probes (no wasted backward
+        # pass).  When the conf regularizes, the probed VALUE must include
+        # the same L2 term the transform chain folds into the direction, or
+        # Armijo measures a different objective than the one descended.
+        if conf.use_regularization and conf.l2 > 0:
+            l2 = conf.l2
+            self._jit_val = jax.jit(
+                lambda p, k: objective(p, k)[0] + tfm.l2_penalty(l2, p))
+        else:
+            self._jit_val = jax.jit(lambda p, k: objective(p, k)[0])
 
     def score(self) -> float:
         return self._score

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Any, Callable, NamedTuple
 
 import jax
-import jax.numpy as jnp
+import jax.numpy as jnp  # noqa: F401  (l2_penalty)
 
 from ..nn.conf import NeuralNetConfiguration
 
@@ -99,14 +99,26 @@ def momentum(base: float, schedule: dict[int, float] | None = None) -> GradientT
 
 
 def weight_decay(l2: float) -> GradientTransform:
-    """L2 regularization contribution g += l2 * w (``BaseOptimizer.java``)."""
+    """L2 regularization g += l2 * w (``BaseOptimizer.java``), applied to
+    weight matrices only (ndim >= 2) — biases stay unregularized, matching
+    the reference, which decays only the "W"-class params."""
 
     def update(grads, s, params=None, iteration=0):
         if params is None:
             return grads, s
-        return tree_map(lambda g, w: g + l2 * w, grads, params), s
+        return tree_map(
+            lambda g, w: g + l2 * w if w.ndim >= 2 else g, grads, params), s
 
     return GradientTransform(lambda p: (), update)
+
+
+def l2_penalty(l2: float, params) -> jnp.ndarray:
+    """0.5*l2*||W||^2 over the same (ndim >= 2) leaves weight_decay touches —
+    use when an objective VALUE must stay consistent with the decayed
+    direction (line-search probes)."""
+    leaves = [0.5 * l2 * jnp.sum(w * w)
+              for w in jax.tree_util.tree_leaves(params) if w.ndim >= 2]
+    return sum(leaves) if leaves else jnp.zeros(())
 
 
 def clip_unit_norm() -> GradientTransform:

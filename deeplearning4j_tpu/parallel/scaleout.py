@@ -194,16 +194,23 @@ class StateTracker:
         with self._lock:
             return self._heartbeats.get(worker_id, 0.0)
 
-    def evict_stale(self, timeout_s: float = 120.0) -> list[str]:
-        """Master-side eviction sweep (``MasterActor.java:123-153``)."""
+    def evict_stale(self, timeout_s: float = 120.0) -> tuple[list[str], list["Job"]]:
+        """Master-side eviction sweep (``MasterActor.java:123-153``).
+
+        Returns (evicted worker ids, their orphaned in-flight jobs) so the
+        master can re-route the work (``StateTracker.loadForWorker`` parity).
+        """
         now = time.time()
-        evicted = []
+        evicted, orphans = [], []
         with self._lock:
             for w in list(self._workers):
                 if now - self._heartbeats.get(w, 0) > timeout_s:
                     evicted.append(w)
+                    job = self._jobs.get(w)
+                    if job is not None:
+                        orphans.append(job)
                     self.remove_worker(w)
-        return evicted
+        return evicted, orphans
 
     # -- jobs -----------------------------------------------------------
     def add_job(self, job: Job) -> None:
@@ -418,11 +425,14 @@ class DistributedRunner:
             t.start()
         deadline = time.time() + max_wall_s
         last_evict = time.time()
+        requeue: list[Job] = []  # orphaned jobs from evicted workers
         try:
             while time.time() < deadline:
-                # eviction sweep (reference: every 60 s; scaled to poll rate)
+                # eviction sweep (reference: every 60 s; scaled to poll rate);
+                # orphaned in-flight jobs are re-routed to live workers
                 if time.time() - last_evict > max(1.0, self.eviction_timeout_s / 2):
-                    self.tracker.evict_stale(self.eviction_timeout_s)
+                    _, orphans = self.tracker.evict_stale(self.eviction_timeout_s)
+                    requeue.extend(orphans)
                     last_evict = time.time()
                 if self.router.send_work():
                     self.router.update()
@@ -430,15 +440,27 @@ class DistributedRunner:
                         current = self.tracker.get_current()
                         if current is not None:
                             self.model_saver.save(current)
-                # dispatch to idle workers
+                # dispatch to idle workers — but never hand a worker its next
+                # job while its previous update awaits aggregation (the
+                # updates dict is keyed by worker: a second result would
+                # overwrite the first, silently breaking the synchronous
+                # superstep average)
                 dispatched = False
+                pending_updates = self.tracker.updates()
                 for wid in self.tracker.workers():
-                    if self.tracker.job_for(wid) is None and self.job_iterator.has_next():
+                    if self.tracker.job_for(wid) is not None or wid in pending_updates:
+                        continue
+                    if requeue:
+                        job = requeue.pop(0)
+                    elif self.job_iterator.has_next():
                         job = self.job_iterator.next(wid)
-                        job.worker_id = wid
-                        self.tracker.add_job(job)
-                        dispatched = True
+                    else:
+                        continue
+                    job.worker_id = wid
+                    self.tracker.add_job(job)
+                    dispatched = True
                 if (not self.job_iterator.has_next()
+                        and not requeue
                         and not self.tracker.current_jobs()
                         and not dispatched):
                     # drain final updates

@@ -79,10 +79,9 @@ class DataParallelTrainer:
                 params, NamedSharding(self.mesh, P(DP)))
         else:
             params = jax.device_put(params, NamedSharding(self.mesh, P()))
-        tstate = (jax.tree_util.tree_map(
-            lambda x: x, self.transform.init(
-                jax.tree_util.tree_map(lambda x: x[0], params)
-                if self.router == "hogwild" else params)))
+        tstate = self.transform.init(
+            jax.tree_util.tree_map(lambda x: x[0], params)
+            if self.router == "hogwild" else params)
         if self.router == "hogwild":
             tstate = jax.tree_util.tree_map(
                 lambda x: (jnp.broadcast_to(x[None], (self.n_dp,) + x.shape)
@@ -153,8 +152,9 @@ class DataParallelTrainer:
         y = jnp.asarray(y)
         if x.shape[0] % self.n_dp != 0:
             pad = self.n_dp - (x.shape[0] % self.n_dp)
-            x = jnp.concatenate([x, x[:pad]])
-            y = jnp.concatenate([y, y[:pad]])
+            idx = jnp.arange(pad) % x.shape[0]  # wrap: pad may exceed batch
+            x = jnp.concatenate([x, x[idx]])
+            y = jnp.concatenate([y, y[idx]])
         state.key, sub = jax.random.split(state.key)
         if self.router == "iterative_reduce":
             if self._step_fn is None:

@@ -76,8 +76,10 @@ def test_heartbeat_eviction():
     t.add_worker("alive")
     t.add_worker("dead")
     t._heartbeats["dead"] = time.time() - 1000
-    evicted = t.evict_stale(timeout_s=120)
+    t.add_job(Job(work="orphan-work", worker_id="dead"))
+    evicted, orphans = t.evict_stale(timeout_s=120)
     assert evicted == ["dead"]
+    assert [j.work for j in orphans] == ["orphan-work"]
     assert t.workers() == ["alive"]
 
 

@@ -1,0 +1,136 @@
+"""Flagship transformer tests: single-device math, and the key sharding
+correctness check — the explicit-SPMD (dp, sp, tp) step with ring attention
+must produce the SAME loss/params as the single-device step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    forward_local,
+    init_params,
+    lm_loss_local,
+    param_specs,
+)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("dtype", jnp.float32)  # exact math for comparisons
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+def data(cfg, batch=8, seq=16, seed=0):
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    tokens = jax.random.randint(k1, (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(k2, (batch, seq), 0, cfg.vocab_size)
+    return tokens, targets
+
+
+def test_forward_shapes():
+    cfg = tiny_cfg()
+    params = init_params(jax.random.key(0), cfg)
+    tokens, _ = data(cfg)
+    logits = forward_local(params, tokens, cfg)
+    assert logits.shape == (8, 16, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_causal_masking():
+    """Changing future tokens must not change past logits (causal)."""
+    cfg = tiny_cfg(causal=True)
+    params = init_params(jax.random.key(0), cfg)
+    tokens, _ = data(cfg)
+    logits1 = forward_local(params, tokens, cfg)
+    tokens2 = tokens.at[:, 10:].set((tokens[:, 10:] + 7) % cfg.vocab_size)
+    logits2 = forward_local(params, tokens2, cfg)
+    np.testing.assert_allclose(np.asarray(logits1[:, :10]),
+                               np.asarray(logits2[:, :10]), atol=1e-5)
+    assert not np.allclose(np.asarray(logits1[:, 10:]), np.asarray(logits2[:, 10:]))
+
+
+def test_bidirectional_mode():
+    cfg = tiny_cfg(causal=False)
+    params = init_params(jax.random.key(0), cfg)
+    tokens, _ = data(cfg)
+    logits1 = forward_local(params, tokens, cfg)
+    tokens2 = tokens.at[:, -1].set((tokens[:, -1] + 3) % cfg.vocab_size)
+    logits2 = forward_local(params, tokens2, cfg)
+    # bidirectional: even position 0 sees the change
+    assert not np.allclose(np.asarray(logits1[:, 0]), np.asarray(logits2[:, 0]))
+
+
+def test_single_device_training_reduces_loss():
+    cfg = tiny_cfg()
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    mom = model.init_momentum(params)
+    tokens, _ = data(cfg)
+    targets = jnp.roll(tokens, -1, axis=1)
+    step = model.build_train_step(lr=0.05)
+    loss0 = None
+    for i in range(30):
+        params, mom, loss = step(params, mom, tokens, targets)
+        if loss0 is None:
+            loss0 = float(loss)
+    assert float(loss) < loss0 * 0.7
+
+
+@pytest.mark.parametrize("meshspec", [
+    MeshSpec(dp=8, sp=1, tp=1),
+    MeshSpec(dp=2, sp=2, tp=2),
+    MeshSpec(dp=1, sp=4, tp=2),
+    MeshSpec(dp=1, sp=8, tp=1),
+])
+def test_sharded_step_matches_single_device(meshspec):
+    """THE sharding correctness check: dp/sp/tp explicit-SPMD step (ring
+    attention + Megatron tp psums + dp grad pmean) == single-device step."""
+    cfg = tiny_cfg()
+    tokens, _ = data(cfg, batch=8, seq=16)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    # single-device ground truth
+    solo = TransformerLM(cfg)
+    p0 = solo.init(jax.random.key(1))
+    m0 = solo.init_momentum(p0)
+    step0 = solo.build_train_step(lr=0.1)
+    p0b, m0b, loss0 = step0(jax.tree_util.tree_map(jnp.array, p0),
+                            jax.tree_util.tree_map(jnp.array, m0),
+                            tokens, targets)
+
+    mesh = make_mesh(meshspec)
+    model = TransformerLM(cfg, mesh=mesh)
+    p1 = solo.init(jax.random.key(1))
+    m1 = model.init_momentum(p1)
+    p1 = model.place(p1)
+    m1 = model.place(m1)
+    step1 = model.build_train_step(lr=0.1)
+    p1b, m1b, loss1 = step1(p1, m1, tokens, targets)
+
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(p1b["layers"][0]["w1"]),
+                               np.asarray(p0b["layers"][0]["w1"]), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(p1b["tok_embed"]),
+                               np.asarray(p0b["tok_embed"]), atol=2e-4)
+
+
+def test_remat_matches_no_remat():
+    cfg = tiny_cfg(remat=False)
+    cfg_r = tiny_cfg(remat=True)
+    params = init_params(jax.random.key(0), cfg)
+    tokens, _ = data(cfg)
+    targets = jnp.roll(tokens, -1, axis=1)
+    g1 = jax.grad(lambda p: lm_loss_local(p, tokens, targets, cfg))(params)
+    g2 = jax.grad(lambda p: lm_loss_local(p, tokens, targets, cfg_r))(params)
+    np.testing.assert_allclose(np.asarray(g1["layers"][0]["w1"]),
+                               np.asarray(g2["layers"][0]["w1"]), rtol=1e-4)
