@@ -1,0 +1,13 @@
+"""Native C++ host runtime (optional acceleration).
+
+Where the reference leans on JVM-external native code (ND4J's JNI/BLAS) for
+host-side heavy lifting, this package holds C++ implementations of the
+host-bound hot paths — IDX/CSV parsing, tokenize+count vocab building,
+prefetch buffering — built as a shared library (``build.py``) and bound via
+ctypes.  Everything has a pure-Python fallback; import of this package never
+fails just because the library isn't built.
+"""
+
+from . import runtime
+
+__all__ = ["runtime"]

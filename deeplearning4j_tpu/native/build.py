@@ -1,0 +1,37 @@
+"""Build the native host runtime: ``python -m deeplearning4j_tpu.native.build``.
+
+g++ -O3 shared library; no external deps.  The library is optional — all
+call sites fall back to pure Python when it is absent.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).parent
+SRC = HERE / "src" / "host_runtime.cpp"
+LIB = HERE / "libdl4jtpu_host.so"
+
+
+def build(verbose: bool = True) -> Path | None:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           str(SRC), "-o", str(LIB)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+        if verbose:
+            print(f"native build unavailable: {e}", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        if verbose:
+            print(f"native build failed:\n{proc.stderr}", file=sys.stderr)
+        return None
+    if verbose:
+        print(f"built {LIB}")
+    return LIB
+
+
+if __name__ == "__main__":
+    sys.exit(0 if build() else 1)

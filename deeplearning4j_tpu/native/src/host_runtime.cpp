@@ -1,0 +1,141 @@
+// Native host runtime for deeplearning4j_tpu.
+//
+// Role (SURVEY.md §2.1): the reference delegates its performance-critical
+// paths to JVM-external native code (ND4J JNI -> BLAS).  In the TPU build
+// the device math is XLA's, so the native seam moves to the HOST-bound hot
+// paths that feed the chip: corpus tokenization/counting for vocab builds
+// and skip-gram pair generation (the per-token Python loops dominate
+// word2vec wall-clock otherwise).  Exposed as a C ABI for ctypes.
+//
+// Build: python -m deeplearning4j_tpu.native.build   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- tokenizer
+// Tokenize text (sentences separated by '\n'), lowercasing and stripping
+// non-alphanumeric bytes (ASCII fast path; multi-byte UTF-8 kept verbatim).
+// Returns a malloc'd buffer "word\tcount\n..." and its length; caller frees
+// via drt_free.
+char* drt_count_tokens(const char* text, int64_t len, int64_t* out_len) {
+    std::unordered_map<std::string, int64_t> counts;
+    std::string cur;
+    cur.reserve(32);
+    for (int64_t i = 0; i <= len; ++i) {
+        unsigned char c = (i < len) ? static_cast<unsigned char>(text[i]) : ' ';
+        bool is_space = (c == ' ' || c == '\t' || c == '\n' || c == '\r');
+        if (is_space) {
+            if (!cur.empty()) {
+                ++counts[cur];
+                cur.clear();
+            }
+            continue;
+        }
+        // ASCII-only fast path (the ctypes binding routes any non-ASCII
+        // corpus to the Python tokenizer so semantics never diverge):
+        // keep [A-Za-z0-9_] lowercased — exactly Python's \w for ASCII.
+        if (std::isalnum(c) || c == '_') {
+            cur.push_back(static_cast<char>(std::tolower(c)));
+        }
+        // punctuation stripped
+    }
+    std::string out;
+    out.reserve(counts.size() * 16);
+    for (const auto& kv : counts) {
+        out += kv.first;
+        out += '\t';
+        out += std::to_string(kv.second);
+        out += '\n';
+    }
+    char* buf = static_cast<char*>(std::malloc(out.size()));
+    std::memcpy(buf, out.data(), out.size());
+    *out_len = static_cast<int64_t>(out.size());
+    return buf;
+}
+
+void drt_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------- skipgram
+// Generate skip-gram (center, context) pairs with per-position random
+// window shrink (word2vec's `b = rand % window`).
+// tokens: concatenated sentence word-indices; offsets: sentence starts
+// (n_sentences+1 entries).  Returns number of pairs written; call first with
+// centers=nullptr to get the required capacity.
+int64_t drt_skipgram_pairs(const int32_t* tokens, const int64_t* offsets,
+                           int64_t n_sentences, int32_t window, uint64_t seed,
+                           int32_t* centers, int32_t* contexts,
+                           int64_t capacity) {
+    uint64_t state = seed ? seed : 0x9E3779B97F4A7C15ull;
+    auto next_rand = [&state]() {
+        state ^= state << 13;
+        state ^= state >> 7;
+        state ^= state << 17;
+        return state;
+    };
+    int64_t n = 0;
+    for (int64_t s = 0; s < n_sentences; ++s) {
+        int64_t lo = offsets[s], hi = offsets[s + 1];
+        int64_t len = hi - lo;
+        for (int64_t pos = 0; pos < len; ++pos) {
+            int32_t b = window > 0 ? static_cast<int32_t>(next_rand() % window) : 0;
+            int32_t w = window - b;
+            int64_t jlo = pos - w < 0 ? 0 : pos - w;
+            int64_t jhi = pos + w + 1 > len ? len : pos + w + 1;
+            for (int64_t j = jlo; j < jhi; ++j) {
+                if (j == pos) continue;
+                if (centers != nullptr) {
+                    if (n >= capacity) return -1;  // caller under-allocated
+                    centers[n] = tokens[lo + pos];
+                    contexts[n] = tokens[lo + j];
+                }
+                ++n;
+            }
+        }
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------- csv
+// Parse a float CSV buffer into a dense row-major array. Returns rows
+// written, or -1 on ragged rows. out must hold max_rows*n_cols floats.
+int64_t drt_parse_csv_floats(const char* text, int64_t len, int32_t n_cols,
+                             float* out, int64_t max_rows) {
+    int64_t row = 0, col = 0;
+    const char* p = text;
+    const char* end = text + len;
+    while (p < end && row < max_rows) {
+        char c = *p;
+        if (c == '\n') {  // newline handled BEFORE strtof (which would
+                          // swallow it as leading whitespace)
+            if (col != 0) {
+                if (col != n_cols) return -1;
+                col = 0;
+                ++row;
+            }
+            ++p;
+            continue;
+        }
+        if (c == ',' || c == ' ' || c == '\t' || c == '\r') {
+            ++p;
+            continue;
+        }
+        char* next = nullptr;
+        float v = std::strtof(p, &next);
+        if (next == p) return -1;  // non-numeric garbage
+        if (col >= n_cols) return -1;
+        out[row * n_cols + col] = v;
+        ++col;
+        p = next;
+    }
+    if (col == n_cols) ++row;
+    else if (col != 0) return -1;
+    return row;
+}
+
+}  // extern "C"

@@ -142,7 +142,15 @@ class BaseOptimizer:
             if self.use_line_search:
                 ls = BackTrackLineSearch(
                     lambda p, s=sub: self._jit_val(p, s))
-                step = ls.optimize(params, direction, grads, initial_step=1.0)
+                # slope must be d(probed objective)·direction: include the L2
+                # term the probe value carries
+                probe_grads = grads
+                if self.conf.use_regularization and self.conf.l2 > 0:
+                    l2 = self.conf.l2
+                    probe_grads = jax.tree_util.tree_map(
+                        lambda g, w: g + l2 * w if w.ndim >= 2 else g,
+                        grads, params)
+                step = ls.optimize(params, direction, probe_grads, initial_step=1.0)
                 params = tm.axpy(step, direction, params)
             else:
                 params = tm.add(params, direction)

@@ -1,0 +1,39 @@
+"""L8 — NLP stack (reference: ``deeplearning4j-nlp``, SURVEY.md §1 L8).
+
+Host side: tokenization, sentence/document iteration, vocab building,
+Huffman coding, co-occurrence counting, serialization — plain Python (with
+native C++ acceleration where profiled).  Device side: batched skip-gram /
+negative-sampling / GloVe updates as jitted segment ops on the TPU — the
+per-pair BLAS axpy loops of the reference's ``InMemoryLookupTable`` become
+one scatter-add per batch.
+"""
+
+from .tokenization import (
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    LowerCasePreProcessor,
+    StripPunctuationPreProcess,
+)
+from .sentence import (
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelAwareListSentenceIterator,
+    LineSentenceIterator,
+)
+from .vocab import Huffman, VocabCache, VocabWord, build_vocab
+from .word2vec import Word2Vec
+from .serializer import load_txt, save_txt, load_google_binary, save_google_binary
+from .glove import Glove
+from .paragraph_vectors import ParagraphVectors
+from .vectorizers import BagOfWordsVectorizer, TfidfVectorizer
+
+__all__ = [
+    "DefaultTokenizer", "DefaultTokenizerFactory", "LowerCasePreProcessor",
+    "StripPunctuationPreProcess",
+    "CollectionSentenceIterator", "FileSentenceIterator",
+    "LabelAwareListSentenceIterator", "LineSentenceIterator",
+    "Huffman", "VocabCache", "VocabWord", "build_vocab",
+    "Word2Vec", "Glove", "ParagraphVectors",
+    "load_txt", "save_txt", "load_google_binary", "save_google_binary",
+    "BagOfWordsVectorizer", "TfidfVectorizer",
+]

@@ -1,0 +1,147 @@
+"""ParagraphVectors (PV-DBOW).
+
+Capability match of ``models/paragraphvectors/ParagraphVectors.java:38,173``:
+document/label vectors trained to predict the words of their documents
+(distributed bag of words), sharing the word-side machinery (Huffman HS or
+negative sampling) with Word2Vec.  Inference for unseen documents trains a
+fresh doc vector with words frozen.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .word2vec import Word2Vec, _hs_step, _ns_step, _sample_negatives
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW on top of the Word2Vec substrate: 'centers' are doc ids into
+    a separate doc-vector table."""
+
+    def __init__(self, sentences: Iterable[str], labels: Sequence[str] | None = None,
+                 **kw):
+        super().__init__(sentences, **kw)
+        self.labels = (list(labels) if labels is not None
+                       else [f"DOC_{i}" for i in range(len(self.sentences))])
+        assert len(self.labels) == len(self.sentences)
+        self.doc_vectors = None
+        self._label_index = {l: i for i, l in enumerate(self.labels)}
+
+    def fit(self) -> "ParagraphVectors":
+        # 1) word vectors via plain skip-gram
+        super().fit()
+        # 2) doc vectors via PV-DBOW against the (frozen-structure) softmax
+        rng = np.random.default_rng(self.seed + 1)
+        key = jax.random.key(self.seed + 1)
+        n_docs, d = len(self.sentences), self.layer_size
+        self.doc_vectors = jnp.asarray(
+            (rng.random((n_docs, d), np.float32) - 0.5) / d)
+        codes = jnp.asarray(self._codes, jnp.float32)
+        points = jnp.asarray(self._points)
+        L = self._codes.shape[1]
+        mask_table = jnp.asarray(
+            (np.arange(L)[None, :] < self._lengths[:, None]).astype(np.float32))
+
+        doc_ids, word_ids = [], []
+        for di, s in enumerate(self.sentences):
+            toks = self.tokenizer_factory.create(s).get_tokens()
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = [i for i in idx if i >= 0]
+            for wi in idx:
+                doc_ids.append(di)
+                word_ids.append(wi)
+        doc_ids = np.asarray(doc_ids, np.int32)
+        word_ids = np.asarray(word_ids, np.int32)
+        alpha = self.learning_rate
+        for it in range(max(1, self.iterations)):
+            perm = rng.permutation(doc_ids.shape[0])
+            for off in range(0, doc_ids.shape[0], self.batch_size):
+                sl = perm[off:off + self.batch_size]
+                db = jnp.asarray(doc_ids[sl])
+                wb = jnp.asarray(word_ids[sl])
+                if self.use_hs:
+                    self.doc_vectors, self.syn1 = _hs_step(
+                        self.doc_vectors, self.syn1, db,
+                        points[wb], codes[wb], mask_table[wb], jnp.float32(alpha))
+                if self.negative > 0:
+                    key, sub = jax.random.split(key)
+                    negs = _sample_negatives(
+                        sub, self._unigram_log, (db.shape[0], self.negative))
+                    targets = jnp.concatenate([wb[:, None], negs], axis=1)
+                    labels = jnp.concatenate(
+                        [jnp.ones((db.shape[0], 1), jnp.float32),
+                         jnp.zeros((db.shape[0], self.negative), jnp.float32)],
+                        axis=1)
+                    self.doc_vectors, self.syn1neg = _ns_step(
+                        self.doc_vectors, self.syn1neg, db, targets, labels,
+                        jnp.float32(alpha))
+        return self
+
+    # ------------------------------------------------------------------ queries
+    def get_doc_vector(self, label: str) -> np.ndarray | None:
+        i = self._label_index.get(label)
+        return None if i is None else np.asarray(self.doc_vectors[i])
+
+    def doc_similarity(self, l1: str, l2: str) -> float:
+        v1, v2 = self.get_doc_vector(l1), self.get_doc_vector(l2)
+        if v1 is None or v2 is None:
+            return 0.0
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(v1 @ v2 / denom) if denom > 0 else 0.0
+
+    def docs_nearest(self, label: str, n: int = 10) -> list[str]:
+        vec = self.get_doc_vector(label)
+        if vec is None:
+            return []
+        dv = np.asarray(self.doc_vectors)
+        sims = dv @ vec / np.maximum(
+            np.linalg.norm(dv, axis=1) * np.linalg.norm(vec), 1e-12)
+        order = np.argsort(-sims)
+        return [self.labels[int(i)] for i in order
+                if self.labels[int(i)] != label][:n]
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     alpha: float = 0.025) -> np.ndarray:
+        """Train a fresh doc vector for unseen text (words frozen)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        idx = np.asarray([self.vocab.index_of(t) for t in toks
+                          if self.vocab.index_of(t) >= 0], np.int32)
+        rng = np.random.default_rng(0)
+        dv = jnp.asarray((rng.random((1, self.layer_size), np.float32) - 0.5)
+                         / self.layer_size)
+        if idx.size == 0:
+            return np.asarray(dv[0])
+        db = jnp.zeros((idx.size,), jnp.int32)
+        wb = jnp.asarray(idx)
+        key = jax.random.key(17)
+        if self.use_hs:
+            codes = jnp.asarray(self._codes, jnp.float32)
+            points = jnp.asarray(self._points)
+            L = self._codes.shape[1]
+            mask_table = jnp.asarray(
+                (np.arange(L)[None, :] < self._lengths[:, None]).astype(np.float32))
+            # local COPY: _hs_step donates its inputs — passing self.syn1
+            # directly would delete the model's buffer
+            syn1 = jnp.array(self.syn1)
+            for _ in range(steps):
+                dv, syn1 = _hs_step(dv, syn1, db, points[wb], codes[wb],
+                                    mask_table[wb], jnp.float32(alpha))
+        else:
+            # NS-only model: the HS tree is untrained zeros — infer against
+            # the trained syn1neg with fresh negatives per step
+            syn1neg = jnp.array(self.syn1neg)
+            ones = jnp.ones((idx.size, 1), jnp.float32)
+            zeros = jnp.zeros((idx.size, self.negative), jnp.float32)
+            labels = jnp.concatenate([ones, zeros], axis=1)
+            for _ in range(steps):
+                key, sub = jax.random.split(key)
+                negs = _sample_negatives(sub, self._unigram_log,
+                                         (idx.size, self.negative))
+                targets = jnp.concatenate([wb[:, None], negs], axis=1)
+                dv, syn1neg = _ns_step(dv, syn1neg, db, targets, labels,
+                                       jnp.float32(alpha))
+        return np.asarray(dv[0])

@@ -1,0 +1,284 @@
+"""Word2Vec — skip-gram with hierarchical softmax and negative sampling,
+batched on the TPU.
+
+Capability match of the reference's ``models/word2vec/Word2Vec.java`` +
+``models/embeddings/inmemory/InMemoryLookupTable.java:144-279``: vocab build
+with min-frequency pruning, Huffman tree, skip-gram windows, hierarchical
+softmax over the Huffman path, negative sampling from the 0.75-power unigram
+table, subsampling, linear LR decay by words processed
+(``Word2VecPerformer.java:82``), similarity/nearest-neighbor queries, and
+(de)serialization via ``serializer``.
+
+TPU-first redesign: the reference updates one (w1, w2) pair at a time with
+BLAS ``axpy`` on host; here the host assembles BATCHES of (center, context,
+padded Huffman path) index arrays and one jitted step performs all updates
+as gathers + scatter-adds — MXU-friendly, thousands of pairs per dispatch.
+The precomputed sigmoid expTable is unnecessary (XLA fuses the exact
+sigmoid); the unigram table becomes a device-side categorical draw.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sentence import CollectionSentenceIterator
+from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
+from .vocab import Huffman, VocabCache, build_vocab
+
+log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------- jitted steps
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, mask, alpha):
+    """Hierarchical-softmax skip-gram update for a batch of pairs.
+
+    centers: (B,) int; points/codes/mask: (B, L) Huffman path arrays.
+    label = 1 - code (word2vec convention); in-place adds via scatter.
+    """
+    h = syn0[centers]                                  # (B, D)
+    w = syn1[points]                                   # (B, L, D)
+    u = jnp.einsum("bd,bld->bl", h, w)
+    p = jax.nn.sigmoid(u)
+    g = (1.0 - codes - p) * alpha * mask               # (B, L)
+    dh = jnp.einsum("bl,bld->bd", g, w)
+    dw = g[:, :, None] * h[:, None, :]
+    syn1 = syn1.at[points].add(dw)
+    syn0 = syn0.at[centers].add(dh)
+    return syn0, syn1
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _ns_step(syn0, syn1neg, centers, targets, labels, alpha):
+    """Negative-sampling update.
+
+    centers: (B,); targets: (B, 1+K) (context + K negatives);
+    labels: (B, 1+K) 1 for context, 0 for negatives.
+    """
+    h = syn0[centers]
+    w = syn1neg[targets]                               # (B, 1+K, D)
+    u = jnp.einsum("bd,bkd->bk", h, w)
+    p = jax.nn.sigmoid(u)
+    g = (labels - p) * alpha
+    dh = jnp.einsum("bk,bkd->bd", g, w)
+    dw = g[:, :, None] * h[:, None, :]
+    syn1neg = syn1neg.at[targets].add(dw)
+    syn0 = syn0.at[centers].add(dh)
+    return syn0, syn1neg
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _sample_negatives(key, probs_log, shape):
+    return jax.random.categorical(key, probs_log, shape=shape)
+
+
+# --------------------------------------------------------------------------- model
+
+class Word2Vec:
+    """Skip-gram embeddings with the reference's knobs."""
+
+    def __init__(self, sentences: Iterable[str] | None = None, *,
+                 layer_size: int = 100, window: int = 5,
+                 min_word_frequency: float = 1.0, iterations: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate: float = 1e-2,
+                 negative: int = 0, use_hierarchic_softmax: bool = True,
+                 sample: float = 0.0, batch_size: int = 4096,
+                 seed: int = 42, tokenizer_factory=None):
+        self.sentences = list(sentences) if sentences is not None else []
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.iterations = iterations
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.negative = negative
+        self.use_hs = use_hierarchic_softmax or negative == 0
+        self.sample = sample
+        self.batch_size = batch_size
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory(
+            CommonPreprocessor())
+
+        self.vocab: VocabCache | None = None
+        self.huffman: Huffman | None = None
+        self.syn0 = None
+        self.syn1 = None
+        self.syn1neg = None
+        self._codes = self._points = self._lengths = None
+        self._unigram_log = None
+
+    # ------------------------------------------------------------------ setup
+    def build_vocab(self) -> None:
+        self.vocab = build_vocab(self.sentences, self.tokenizer_factory,
+                                 self.min_word_frequency)
+        self.huffman = Huffman(self.vocab)
+        self.huffman.build()
+        self._codes, self._points, self._lengths = self.huffman.code_arrays()
+
+    def reset_weights(self) -> None:
+        """syn0 uniform +-0.5/dim, syn1 zeros (InMemoryLookupTable
+        ``resetWeights``)."""
+        n, d = len(self.vocab), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = jnp.asarray(
+            (rng.random((n, d), np.float32) - 0.5) / d)
+        self.syn1 = jnp.zeros((max(n - 1, 1), d), jnp.float32)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((n, d), jnp.float32)
+            counts = self.vocab.counts_array() ** 0.75
+            self._unigram_log = jnp.asarray(
+                np.log(counts / counts.sum()), dtype=jnp.float32)
+
+    # ------------------------------------------------------------------ data
+    def _sentence_indices(self, rng: np.random.Generator) -> list[np.ndarray]:
+        """Tokenize sentences to pruned index arrays, with subsampling."""
+        out = []
+        total = self.vocab.total_word_count
+        for s in self.sentences:
+            toks = self.tokenizer_factory.create(s).get_tokens()
+            idx = [self.vocab.index_of(t) for t in toks]
+            idx = np.array([i for i in idx if i >= 0], np.int32)
+            if self.sample > 0 and idx.size:
+                freqs = self.vocab.counts_array()[idx] / total
+                keep_p = np.minimum(1.0, np.sqrt(self.sample / freqs)
+                                    + self.sample / freqs)
+                idx = idx[rng.random(idx.size) < keep_p]
+            if idx.size >= 2:
+                out.append(idx)
+        return out
+
+    def _pairs(self, sentences_idx: Sequence[np.ndarray],
+               rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray]:
+        """All (center, context) skip-gram pairs with random window shrink
+        (the reference draws a random gap per position, Word2Vec.java:312).
+        Native C++ fast path when the host library is built."""
+        try:
+            from ..native import runtime as native_rt
+            native = native_rt.skipgram_pairs(
+                list(sentences_idx), self.window, int(rng.integers(1, 2**63)))
+            if native is not None:
+                return native
+        except ImportError:
+            pass
+        centers, contexts = [], []
+        for idx in sentences_idx:
+            n = idx.size
+            b = rng.integers(0, self.window, n)  # random reduced window
+            for pos in range(n):
+                w = self.window - b[pos]
+                lo, hi = max(0, pos - w), min(n, pos + w + 1)
+                for j in range(lo, hi):
+                    if j != pos:
+                        centers.append(idx[pos])
+                        contexts.append(idx[j])
+        if not centers:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> "Word2Vec":
+        if self.vocab is None:
+            self.build_vocab()
+        if self.syn0 is None:
+            self.reset_weights()
+        rng = np.random.default_rng(self.seed)
+        key = jax.random.key(self.seed)
+        codes = jnp.asarray(self._codes, jnp.float32)
+        points = jnp.asarray(self._points)
+        L = self._codes.shape[1]
+        mask_table = jnp.asarray(
+            (np.arange(L)[None, :] < self._lengths[:, None]).astype(np.float32))
+
+        # Linear alpha decay over total training PAIRS (the reference decays
+        # by words seen, Word2VecPerformer.java:82; pairs are the unit our
+        # batches process — estimated from the first epoch's pair count so
+        # the schedule spans all of training instead of collapsing early).
+        pairs_total = None
+        pairs_seen = 0.0
+        for it in range(self.iterations):
+            sidx = self._sentence_indices(rng)
+            centers, contexts = self._pairs(sidx, rng)
+            n_pairs = centers.shape[0]
+            if pairs_total is None:
+                pairs_total = max(1.0, float(n_pairs) * self.iterations)
+            perm = rng.permutation(n_pairs)
+            centers, contexts = centers[perm], contexts[perm]
+            for off in range(0, n_pairs, self.batch_size):
+                cb = jnp.asarray(centers[off:off + self.batch_size])
+                xb = jnp.asarray(contexts[off:off + self.batch_size])
+                alpha = max(self.min_learning_rate,
+                            self.learning_rate * (1.0 - pairs_seen / pairs_total))
+                if self.use_hs:
+                    self.syn0, self.syn1 = _hs_step(
+                        self.syn0, self.syn1, cb,
+                        points[xb], codes[xb], mask_table[xb],
+                        jnp.float32(alpha))
+                if self.negative > 0:
+                    key, sub = jax.random.split(key)
+                    negs = _sample_negatives(
+                        sub, self._unigram_log, (cb.shape[0], self.negative))
+                    targets = jnp.concatenate([xb[:, None], negs], axis=1)
+                    labels = jnp.concatenate(
+                        [jnp.ones((cb.shape[0], 1), jnp.float32),
+                         jnp.zeros((cb.shape[0], self.negative), jnp.float32)],
+                        axis=1)
+                    self.syn0, self.syn1neg = _ns_step(
+                        self.syn0, self.syn1neg, cb, targets, labels,
+                        jnp.float32(alpha))
+                pairs_seen += cb.shape[0]
+        return self
+
+    # ------------------------------------------------------------------ queries
+    def get_word_vector(self, word: str) -> np.ndarray | None:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.syn0[i])
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def similarity(self, w1: str, w2: str) -> float:
+        v1, v2 = self.get_word_vector(w1), self.get_word_vector(w2)
+        if v1 is None or v2 is None:
+            return 0.0
+        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
+        return float(v1 @ v2 / denom) if denom > 0 else 0.0
+
+    def words_nearest(self, word_or_vec, n: int = 10) -> list[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+            if vec is None:
+                return []
+        else:
+            vec, exclude = np.asarray(word_or_vec), set()
+        syn0 = np.asarray(self.syn0)
+        norms = np.linalg.norm(syn0, axis=1) * (np.linalg.norm(vec) + 1e-12)
+        sims = syn0 @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at(int(i))
+            if w not in exclude:
+                out.append(w)
+            if len(out) >= n:
+                break
+        return out
+
+    def accuracy(self, analogies: Sequence[tuple[str, str, str, str]]) -> float:
+        """a:b :: c:d analogy accuracy (reference ``accuracy`` API)."""
+        good = 0
+        for a, b, c, d in analogies:
+            va, vb, vc = (self.get_word_vector(w) for w in (a, b, c))
+            if va is None or vb is None or vc is None:
+                continue
+            pred = self.words_nearest(vb - va + vc, n=4)
+            if d in pred:
+                good += 1
+        return good / max(1, len(analogies))

@@ -1,0 +1,204 @@
+"""NLP stack tests (mirror of the reference's Word2VecTests / GloveTest /
+ParagraphVectorsTest / tokenizer & vectorizer tests / WordVectorSerializerTest
+— small corpus fixtures, semantic-sanity assertions)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    LabelAwareListSentenceIterator,
+    LineSentenceIterator,
+    ParagraphVectors,
+    TfidfVectorizer,
+    VocabCache,
+    Word2Vec,
+    build_vocab,
+)
+from deeplearning4j_tpu.text.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizer,
+    NGramTokenizer,
+)
+from deeplearning4j_tpu.text.serializer import (
+    load_google_binary,
+    load_into_word2vec,
+    load_txt,
+    save_google_binary,
+    save_txt,
+    save_word2vec,
+)
+
+# A tiny corpus with two clear topic clusters (fruit vs vehicles).
+CORPUS = [
+    "the apple is a sweet fruit",
+    "banana is a yellow fruit and the banana is sweet",
+    "orange fruit is sweet and orange is juicy",
+    "apple and banana and orange are fruit",
+    "fruit salad has apple banana orange",
+    "the car drives on the road",
+    "a truck is a big car on the road",
+    "the bus drives people on the road",
+    "car truck and bus are vehicles on the road",
+    "vehicles like car and bus drive fast",
+] * 8
+
+
+def test_tokenizer_and_preprocessors():
+    t = DefaultTokenizer("Hello, World! 42 foo-bar")
+    assert t.get_tokens() == ["Hello,", "World!", "42", "foo-bar"]
+    t2 = DefaultTokenizer("Hello, World!", CommonPreprocessor())
+    assert t2.get_tokens() == ["hello", "world"]
+    ng = NGramTokenizer("a b c", n=2)
+    assert "a b" in ng.get_tokens() and "b c" in ng.get_tokens()
+
+
+def test_sentence_iterators(tmp_path):
+    it = CollectionSentenceIterator(["s one", "s two"])
+    assert list(it) == ["s one", "s two"]
+    it.pre_processor = str.upper
+    assert list(it) == ["S ONE", "S TWO"]
+    p = tmp_path / "corpus.txt"
+    p.write_text("line one\n\nline two\n")
+    assert list(LineSentenceIterator(p)) == ["line one", "line two"]
+    la = LabelAwareListSentenceIterator(["a", "b"], ["L0", "L1"])
+    la.next_sentence()
+    assert la.current_label() == "L0"
+
+
+def test_vocab_build_and_prune():
+    cache = build_vocab(CORPUS, DefaultTokenizerFactory(CommonPreprocessor()),
+                        min_word_frequency=5)
+    assert "fruit" in cache and "car" in cache
+    assert cache.index_of("nonexistent") == -1
+    # most frequent word gets index 0
+    counts = cache.counts_array()
+    assert counts[0] == counts.max()
+
+
+def test_native_vocab_matches_python():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    fast = build_vocab(CORPUS, tf, min_word_frequency=1, use_native=True)
+    slow = build_vocab(CORPUS, tf, min_word_frequency=1, use_native=False)
+    assert set(fast.words()) == set(slow.words())
+    for w in slow.words():
+        assert fast.count_of(w) == slow.count_of(w), w
+
+
+def test_huffman_codes():
+    cache = build_vocab(CORPUS, DefaultTokenizerFactory(CommonPreprocessor()))
+    h = Huffman(cache)
+    h.build()
+    # Kraft equality for a full binary tree: sum 2^-len == 1
+    total = sum(2.0 ** -len(cache.word_for(w).codes) for w in cache.words())
+    assert abs(total - 1.0) < 1e-9
+    # frequent words get shorter codes
+    ws = cache.words()
+    assert len(cache.word_for(ws[0]).codes) <= len(cache.word_for(ws[-1]).codes)
+    codes, points, lengths = h.code_arrays()
+    assert codes.shape == points.shape
+    assert lengths.max() == h.max_code_length
+
+
+def test_word2vec_hs_learns_topics():
+    model = Word2Vec(CORPUS, layer_size=32, window=3, iterations=8,
+                     min_word_frequency=3, seed=7)
+    model.fit()
+    assert model.has_word("apple") and model.has_word("car")
+    # within-topic similarity beats cross-topic
+    fruit_sim = model.similarity("apple", "banana")
+    cross_sim = model.similarity("apple", "road")
+    assert fruit_sim > cross_sim, (fruit_sim, cross_sim)
+    assert model.get_word_vector("apple").shape == (32,)
+    near = model.words_nearest("car", n=5)
+    assert len(near) == 5 and "car" not in near
+
+
+def test_word2vec_negative_sampling():
+    model = Word2Vec(CORPUS, layer_size=32, window=3, iterations=8,
+                     min_word_frequency=3, negative=5,
+                     use_hierarchic_softmax=False, seed=7)
+    model.fit()
+    assert model.similarity("banana", "orange") > model.similarity("banana", "bus")
+
+
+def test_word2vec_subsampling_runs():
+    model = Word2Vec(CORPUS, layer_size=16, window=2, iterations=2,
+                     sample=1e-3, seed=3)
+    model.fit()
+    assert np.all(np.isfinite(np.asarray(model.syn0)))
+
+
+def test_serializer_roundtrips(tmp_path):
+    words = ["alpha", "beta", "gamma"]
+    vecs = np.random.default_rng(0).random((3, 8)).astype(np.float32)
+    save_txt(words, vecs, tmp_path / "v.txt")
+    w2, v2 = load_txt(tmp_path / "v.txt")
+    assert w2 == words
+    np.testing.assert_allclose(v2, vecs, rtol=1e-4)
+    save_google_binary(words, vecs, tmp_path / "v.bin")
+    w3, v3 = load_google_binary(tmp_path / "v.bin")
+    assert w3 == words
+    np.testing.assert_allclose(v3, vecs)
+
+
+def test_word2vec_save_load_query(tmp_path):
+    model = Word2Vec(CORPUS, layer_size=16, iterations=2, min_word_frequency=3)
+    model.fit()
+    save_word2vec(model, tmp_path / "w2v.bin", binary=True)
+    loaded = load_into_word2vec(tmp_path / "w2v.bin", binary=True)
+    np.testing.assert_allclose(loaded.get_word_vector("fruit"),
+                               model.get_word_vector("fruit"), rtol=1e-5)
+
+
+def test_glove_learns_topics():
+    model = Glove(CORPUS, layer_size=24, window=5, iterations=30,
+                  min_word_frequency=3, seed=5)
+    model.fit()
+    assert model.losses[-1] < model.losses[0]
+    assert model.similarity("apple", "banana") > model.similarity("apple", "road")
+
+
+def test_paragraph_vectors():
+    labels = [f"DOC_{i}" for i in range(len(CORPUS))]
+    model = ParagraphVectors(CORPUS, labels, layer_size=24, window=3,
+                             iterations=6, min_word_frequency=3, seed=11)
+    model.fit()
+    # doc 0 (fruit) should be nearer doc 1 (fruit) than doc 5 (vehicles)
+    assert model.doc_similarity("DOC_0", "DOC_1") > model.doc_similarity("DOC_0", "DOC_5")
+    vec = model.infer_vector("sweet apple banana fruit")
+    assert vec.shape == (24,) and np.all(np.isfinite(vec))
+
+
+def test_bow_and_tfidf():
+    docs = ["apple banana apple", "car road car car", "apple car"]
+    bow = BagOfWordsVectorizer()
+    x = bow.fit_transform(docs)
+    assert x.shape == (3, len(bow.vocab))
+    assert x[0, bow.vocab.index_of("apple")] == 2.0
+    tfidf = TfidfVectorizer()
+    xt = tfidf.fit_transform(docs)
+    # 'apple' appears in 2/3 docs; within doc0 tf=2/3
+    assert xt.shape == x.shape
+    assert np.all(np.isfinite(xt))
+    ds = bow.vectorize(docs, [0, 1, 0])
+    assert ds.num_outcomes() == 2
+
+
+def test_native_skipgram_pairs_match_python_counts():
+    from deeplearning4j_tpu.native import runtime as native_rt
+    if native_rt.lib() is None:
+        pytest.skip("native lib unavailable")
+    sents = [np.array([0, 1, 2, 3, 4], np.int32), np.array([5, 6, 7], np.int32)]
+    out = native_rt.skipgram_pairs(sents, window=2, seed=123)
+    assert out is not None
+    centers, contexts = out
+    assert centers.shape == contexts.shape and centers.size > 0
+    # no pair crosses a sentence boundary
+    first = set(range(5))
+    for c, x in zip(centers.tolist(), contexts.tolist()):
+        assert (c in first) == (x in first)
