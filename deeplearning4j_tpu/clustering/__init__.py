@@ -1,0 +1,8 @@
+"""L4 — clustering & spatial trees (reference: ``clustering/``)."""
+
+from .kmeans import KMeansClustering
+from .kdtree import KDTree
+from .vptree import VPTree
+from .quadtree import QuadTree
+
+__all__ = ["KMeansClustering", "KDTree", "VPTree", "QuadTree"]

@@ -233,11 +233,24 @@ class CSVDataFetcher(BaseDataFetcher):
         ncol = len(rows[0])
         lc = self.label_col % ncol
         raw_labels = [r[lc] for r in rows]
-        feats = np.array([[float(v) for j, v in enumerate(r) if j != lc] for r in rows],
-                         dtype=np.float32)
+        feats = self._parse_features(lines, rows, ncol, lc)
         try:
             label_idx = np.array([int(float(v)) for v in raw_labels])
         except ValueError:
             vocab = {v: i for i, v in enumerate(sorted(set(raw_labels)))}
             label_idx = np.array([vocab[v] for v in raw_labels])
         return feats, to_outcome_matrix(label_idx, int(label_idx.max()) + 1)
+
+    def _parse_features(self, lines, rows, ncol, lc) -> np.ndarray:
+        """Feature columns as float32; native C parser fast path when the
+        WHOLE grid is numeric (labels included), Python otherwise."""
+        if self.delimiter == ",":
+            try:
+                from ..native import runtime as native_rt
+                full = native_rt.parse_csv_floats("\n".join(lines) + "\n", ncol)
+            except ImportError:
+                full = None
+            if full is not None and full.shape[0] == len(rows):
+                return np.delete(full, lc, axis=1).astype(np.float32)
+        return np.array([[float(v) for j, v in enumerate(r) if j != lc]
+                         for r in rows], dtype=np.float32)

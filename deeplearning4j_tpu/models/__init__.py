@@ -9,6 +9,11 @@ a BERT/GPT-class encoder with explicit SPMD sharding (dp/tp/sp with ring
 attention) — and ``resnet.py``.
 """
 
+from .lstm import LSTMSequenceModel
+from .resnet import ResNet, ResNetConfig
 from .transformer import TransformerConfig, TransformerLM
+from .zoo import dbn, lenet, mlp, stacked_denoising_autoencoder
 
-__all__ = ["TransformerConfig", "TransformerLM"]
+__all__ = ["LSTMSequenceModel", "ResNet", "ResNetConfig",
+           "TransformerConfig", "TransformerLM",
+           "dbn", "lenet", "mlp", "stacked_denoising_autoencoder"]

@@ -1,0 +1,119 @@
+"""LSTM sequence model: training, sampling, and beam-search decoding.
+
+Capability match of ``models/classifiers/lstm/LSTM.java`` (char-rnn style):
+train x->next-token with the concatenated-gate LSTM from ``nn.layers``
+(autodiff BPTT under ``lax.scan`` replaces the manual backward ``:63-140``),
+then decode with temperature sampling or beam search (``:241-340``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.conf import LayerKind, NeuralNetConfiguration
+from ..nn.layers import LSTM as LSTMLayer
+
+
+class LSTMSequenceModel:
+    def __init__(self, vocab_size: int, hidden_size: int = 128, *,
+                 lr: float = 0.1, seed: int = 0):
+        self.conf = NeuralNetConfiguration(
+            kind=LayerKind.LSTM, n_in=vocab_size, n_out=vocab_size,
+            hidden_size=hidden_size, activation="softmax", lr=lr, seed=seed)
+        self.layer = LSTMLayer(self.conf)
+        self.params = None
+        self._step = None
+
+    def init(self, key=None):
+        self.params = self.layer.init(key if key is not None else
+                                      jax.random.key(self.conf.seed))
+        return self.params
+
+    # ------------------------------------------------------------------ train
+    def fit_sequence(self, tokens: np.ndarray, epochs: int = 100) -> list[float]:
+        """Next-token training on one index sequence (char-rnn style)."""
+        if self.params is None:
+            self.init()
+        v = self.conf.n_in
+        x = jax.nn.one_hot(jnp.asarray(tokens[:-1]), v)
+        y = jax.nn.one_hot(jnp.asarray(tokens[1:]), v)
+        if self._step is None:
+            lr = self.conf.lr
+
+            @jax.jit
+            def step(params, x, y):
+                loss, g = jax.value_and_grad(self.layer.loss)(params, x, y)
+                params = jax.tree_util.tree_map(lambda p, gg: p - lr * gg, params, g)
+                return params, loss
+
+            self._step = step
+        losses = []
+        for _ in range(epochs):
+            self.params, loss = self._step(self.params, x, y)
+            losses.append(float(loss))
+        return losses
+
+    # ------------------------------------------------------------------ decode
+    def _step_logits(self, carry, token_id: int):
+        v = self.conf.n_in
+        x_t = jax.nn.one_hot(jnp.asarray(token_id), v)
+        carry, h = self.layer._step(self.params, carry, x_t)
+        logits = h @ self.params["decoderweights"] + self.params["decoderbias"]
+        return carry, np.asarray(jax.nn.log_softmax(logits))
+
+    def _init_carry(self):
+        d = self.conf.hidden_size or self.conf.n_out
+        return (jnp.zeros((d,)), jnp.zeros((d,)))
+
+    def sample(self, prime: list[int], length: int, temperature: float = 1.0,
+               seed: int = 0) -> list[int]:
+        """Temperature sampling continuation of ``prime``."""
+        rng = np.random.default_rng(seed)
+        carry = self._init_carry()
+        logp = None
+        for t in prime:
+            carry, logp = self._step_logits(carry, t)
+        out = list(prime)
+        for _ in range(length):
+            p = np.exp(logp / temperature)
+            p /= p.sum()
+            t = int(rng.choice(len(p), p=p))
+            out.append(t)
+            carry, logp = self._step_logits(carry, t)
+        return out
+
+    def beam_search(self, prime: list[int], length: int, beam_width: int = 5
+                    ) -> tuple[list[int], float]:
+        """Highest-log-likelihood continuation (``LSTM.java BeamSearch``).
+
+        Returns (token sequence, total log prob)."""
+        carry = self._init_carry()
+        logp = None
+        for t in prime:
+            carry, logp = self._step_logits(carry, t)
+        beams = [(0.0, list(prime), carry, logp)]
+        for _ in range(length):
+            candidates = []
+            for score, seq, c, lp in beams:
+                top = np.argsort(-lp)[:beam_width]
+                for t in top:
+                    candidates.append((score + float(lp[t]), seq + [int(t)], c, int(t)))
+            candidates.sort(key=lambda s: -s[0])
+            new_beams = []
+            for score, seq, c, t in candidates[:beam_width]:
+                c2, lp2 = self._step_logits(c, t)
+                new_beams.append((score, seq, c2, lp2))
+            beams = new_beams
+        best = max(beams, key=lambda b: b[0])
+        return best[1], best[0]
+
+    def predict_next(self, prime: list[int]) -> int:
+        carry = self._init_carry()
+        logp = None
+        for t in prime:
+            carry, logp = self._step_logits(carry, t)
+        return int(np.argmax(logp))

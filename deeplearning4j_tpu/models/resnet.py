@@ -1,0 +1,147 @@
+"""ResNet — the second north-star model family (BASELINE.json: ResNet-50
+ImageNet).
+
+TPU-first: NHWC layout, bf16 MXU compute with f32 params, batch-norm with
+batch statistics (training) folded next to convs for XLA fusion, and the
+data-parallel path through ``parallel.trainer`` (batch sharded on dp,
+XLA-inserted gradient all-reduce).  Functional init/apply like ``nn.layers``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def resnet18(cls, num_classes=1000, **kw):
+        return cls(num_classes=num_classes, stage_sizes=(2, 2, 2, 2), **kw)
+
+    @classmethod
+    def resnet50(cls, num_classes=1000, **kw):
+        return cls(num_classes=num_classes, stage_sizes=(3, 4, 6, 3), **kw)
+
+
+def _conv_init(key, shape, pd):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return (jax.random.normal(key, shape) * np.sqrt(2.0 / fan_in)).astype(pd)
+
+
+def _bn_params(c, pd):
+    return {"scale": jnp.ones((c,), pd), "bias": jnp.zeros((c,), pd)}
+
+
+def init_params(key, cfg: ResNetConfig) -> dict:
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(key, 2048))
+    params: dict = {
+        "stem": {"conv": _conv_init(next(keys), (7, 7, 3, cfg.width), pd),
+                 "bn": _bn_params(cfg.width, pd)},
+        "stages": [],
+    }
+    c_in = cfg.width
+    for s, blocks in enumerate(cfg.stage_sizes):
+        c_mid = cfg.width * (2 ** s)
+        c_out = c_mid * 4
+        stage = []
+        for b in range(blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            blk = {
+                "conv1": _conv_init(next(keys), (1, 1, c_in, c_mid), pd),
+                "bn1": _bn_params(c_mid, pd),
+                "conv2": _conv_init(next(keys), (3, 3, c_mid, c_mid), pd),
+                "bn2": _bn_params(c_mid, pd),
+                "conv3": _conv_init(next(keys), (1, 1, c_mid, c_out), pd),
+                "bn3": _bn_params(c_out, pd),
+            }
+            if c_in != c_out or stride != 1:
+                blk["proj"] = _conv_init(next(keys), (1, 1, c_in, c_out), pd)
+                blk["proj_bn"] = _bn_params(c_out, pd)
+            stage.append(blk)
+            c_in = c_out
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (c_in, cfg.num_classes)) *
+              np.sqrt(1.0 / c_in)).astype(pd),
+        "b": jnp.zeros((cfg.num_classes,), pd),
+    }
+    return params
+
+
+def _conv(x, w, stride=1, dtype=jnp.bfloat16):
+    return lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=(0, 1, 2), keepdims=True)
+    var = x32.var(axis=(0, 1, 2), keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _bottleneck(x, blk, stride, dtype):
+    h = jax.nn.relu(_bn(_conv(x, blk["conv1"], 1, dtype), blk["bn1"]))
+    h = jax.nn.relu(_bn(_conv(h, blk["conv2"], stride, dtype), blk["bn2"]))
+    h = _bn(_conv(h, blk["conv3"], 1, dtype), blk["bn3"])
+    if "proj" in blk:
+        x = _bn(_conv(x, blk["proj"], stride, dtype), blk["proj_bn"])
+    return jax.nn.relu(x + h)
+
+
+def forward(params, images, cfg: ResNetConfig) -> jnp.ndarray:
+    """images: (N, H, W, 3) -> logits (N, num_classes)."""
+    dt = cfg.dtype
+    x = _conv(images, params["stem"]["conv"], 2, dt)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for s, stage in enumerate(params["stages"]):
+        for b, blk in enumerate(stage):
+            stride = 2 if (s > 0 and b == 0) else 1
+            x = _bottleneck(x, blk, stride, dt)
+    x = x.mean(axis=(1, 2)).astype(jnp.float32)       # global average pool
+    return x @ params["head"]["w"].astype(jnp.float32) + params["head"]["b"]
+
+
+def cross_entropy(params, images, labels, cfg: ResNetConfig) -> jnp.ndarray:
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+class ResNet:
+    def __init__(self, cfg: ResNetConfig):
+        self.cfg = cfg
+        self.params = None
+        self._fwd = None
+
+    def init(self, key=None):
+        self.params = init_params(key if key is not None else jax.random.key(0),
+                                  self.cfg)
+        return self.params
+
+    def predict_logits(self, images):
+        if self._fwd is None:
+            self._fwd = jax.jit(partial(forward, cfg=self.cfg))
+        return self._fwd(self.params, jnp.asarray(images))
+
+    def loss_fn(self):
+        """(params, x, y, key) -> scalar, pluggable into parallel.trainer."""
+        cfg = self.cfg
+        return lambda p, x, y, k=None: cross_entropy(p, x, y, cfg)

@@ -29,7 +29,8 @@ char* drt_count_tokens(const char* text, int64_t len, int64_t* out_len) {
     cur.reserve(32);
     for (int64_t i = 0; i <= len; ++i) {
         unsigned char c = (i < len) ? static_cast<unsigned char>(text[i]) : ' ';
-        bool is_space = (c == ' ' || c == '\t' || c == '\n' || c == '\r');
+        bool is_space = (c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+                         c == '\v' || c == '\f');  // match Python \S+
         if (is_space) {
             if (!cur.empty()) {
                 ++counts[cur];

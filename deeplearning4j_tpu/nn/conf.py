@@ -249,10 +249,16 @@ class MultiLayerConfiguration:
     use_gauss_newton_vector_product_back_prop: bool = False
     damping_factor: float = 100.0        # HF damping default (MultiLayerConfiguration.java:22)
     use_rbm_propagation: bool = False    # propagate via sampled vs mean activations in pretrain
+    # per-layer OutputPreProcessor map (reference: ``MultiLayerConfiguration``
+    # processors + ``nn/conf/preprocessor/ReshapePreProcessor``): name of a
+    # registered post-processing applied to layer i's OUTPUT before layer i+1.
+    preprocessors: dict[int, str] = field(default_factory=dict)
 
     def __post_init__(self):
         object.__setattr__(self, "confs", tuple(self.confs))
         object.__setattr__(self, "hidden_layer_sizes", tuple(self.hidden_layer_sizes))
+        object.__setattr__(self, "preprocessors",
+                           {int(k): v for k, v in dict(self.preprocessors).items()})
 
     @property
     def n_layers(self) -> int:
@@ -272,6 +278,7 @@ class MultiLayerConfiguration:
             "use_gauss_newton_vector_product_back_prop": self.use_gauss_newton_vector_product_back_prop,
             "damping_factor": self.damping_factor,
             "use_rbm_propagation": self.use_rbm_propagation,
+            "preprocessors": {str(k): v for k, v in self.preprocessors.items()},
         }
 
     @classmethod

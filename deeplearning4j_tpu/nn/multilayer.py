@@ -51,6 +51,14 @@ log = logging.getLogger(__name__)
 
 Params = tuple[dict[str, jnp.ndarray], ...]
 
+# OutputPreProcessor registry (reference: ``nn/conf/preprocessor/
+# ReshapePreProcessor`` + ``nn/layers/convolution/preprocessor/*``): named
+# transforms applied to a layer's OUTPUT before the next layer.
+PREPROCESSORS: dict[str, Callable] = {
+    "flatten": lambda h: h.reshape(h.shape[0], -1),
+    "none": lambda h: h,
+}
+
 
 class MultiLayerNetwork:
     """Layer stack + training orchestration."""
@@ -79,21 +87,26 @@ class MultiLayerNetwork:
             self.init()
 
     # ------------------------------------------------------------------ forward
+    def _preproc(self, i: int, h):
+        """Apply layer i's OutputPreProcessor (``feedForward:419-421``)."""
+        name = self.conf.preprocessors.get(i)
+        return PREPROCESSORS[name](h) if name else h
+
     def feed_forward_fn(self, params: Params, x, rng=None, train: bool = False):
         """Pure forward returning all activations (``feedForward:408-474``)."""
         acts = [x]
         rngs = (jax.random.split(rng, len(self.layers))
                 if rng is not None else [None] * len(self.layers))
         h = x
-        for layer, p, r in zip(self.layers, params, rngs):
-            h = layer.activate(p, h, rng=r, train=train)
+        for i, (layer, p, r) in enumerate(zip(self.layers, params, rngs)):
+            h = self._preproc(i, layer.activate(p, h, rng=r, train=train))
             acts.append(h)
         return acts
 
     def _forward(self, params: Params, x):
         h = x
-        for layer, p in zip(self.layers, params):
-            h = layer.activate(p, h)
+        for i, (layer, p) in enumerate(zip(self.layers, params)):
+            h = self._preproc(i, layer.activate(p, h))
         return h
 
     def feed_forward(self, x) -> list:
@@ -127,8 +140,8 @@ class MultiLayerNetwork:
         h = x
         rngs = (jax.random.split(rng, len(self.layers))
                 if rng is not None else [None] * len(self.layers))
-        for layer, p, r in zip(self.layers[:-1], params[:-1], rngs[:-1]):
-            h = layer.activate(p, h, rng=r, train=train)
+        for i, (layer, p, r) in enumerate(zip(self.layers[:-1], params[:-1], rngs[:-1])):
+            h = self._preproc(i, layer.activate(p, h, rng=r, train=train))
         if hasattr(out_layer, "loss"):  # OutputLayer, LSTM, or any loss-bearing tail
             return out_layer.loss(params[-1], h, labels)
         raise TypeError(f"final layer {type(out_layer).__name__} has no loss")
@@ -178,8 +191,8 @@ class MultiLayerNetwork:
         if fn is None:
             def forward_to(params, x):
                 h = x
-                for layer, p in zip(self.layers[:i], params[:i]):
-                    h = layer.activate(p, h)
+                for j, (layer, p) in enumerate(zip(self.layers[:i], params[:i])):
+                    h = self._preproc(j, layer.activate(p, h))
                 return h
             fn = jax.jit(forward_to)
             self._jit_cache[("fwd_to", i)] = fn

@@ -146,10 +146,7 @@ class BaseOptimizer:
                 # term the probe value carries
                 probe_grads = grads
                 if self.conf.use_regularization and self.conf.l2 > 0:
-                    l2 = self.conf.l2
-                    probe_grads = jax.tree_util.tree_map(
-                        lambda g, w: g + l2 * w if w.ndim >= 2 else g,
-                        grads, params)
+                    probe_grads = tfm.l2_grad(self.conf.l2, grads, params)
                 step = ls.optimize(params, direction, probe_grads, initial_step=1.0)
                 params = tm.axpy(step, direction, params)
             else:

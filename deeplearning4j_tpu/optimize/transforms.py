@@ -106,10 +106,15 @@ def weight_decay(l2: float) -> GradientTransform:
     def update(grads, s, params=None, iteration=0):
         if params is None:
             return grads, s
-        return tree_map(
-            lambda g, w: g + l2 * w if w.ndim >= 2 else g, grads, params), s
+        return l2_grad(l2, grads, params), s
 
     return GradientTransform(lambda p: (), update)
+
+
+def l2_grad(l2: float, grads, params):
+    """g + l2*w over the same (ndim >= 2) leaves weight_decay touches — the
+    single source of truth for 'which leaves get decayed'."""
+    return tree_map(lambda g, w: g + l2 * w if w.ndim >= 2 else g, grads, params)
 
 
 def l2_penalty(l2: float, params) -> jnp.ndarray:

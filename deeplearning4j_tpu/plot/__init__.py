@@ -1,0 +1,7 @@
+"""L4 — visualization (reference: ``plot/``)."""
+
+from .tsne import BarnesHutTsne, Tsne
+from .renderers import FilterRenderer, NeuralNetPlotter, draw_mnist_grid
+
+__all__ = ["BarnesHutTsne", "Tsne", "FilterRenderer", "NeuralNetPlotter",
+           "draw_mnist_grid"]

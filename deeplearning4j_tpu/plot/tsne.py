@@ -1,0 +1,196 @@
+"""t-SNE: exact (TPU pairwise kernels) and Barnes-Hut variants.
+
+Capability match of ``plot/Tsne.java:42`` (exact t-SNE with adaptive-
+perplexity binary search ``hBeta``/``computeGaussianPerplexity`` at
+``:143,164,261-428``) and ``plot/BarnesHutTsne.java:36`` (theta-approximated
+gradient with the quad tree).  TPU-first split: the exact variant's O(n^2)
+pairwise affinity and gradient math runs as jitted dense kernels (MXU
+distance matrices); Barnes-Hut stays host-side (pointer-chasing tree walk)
+for large n.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..clustering.quadtree import QuadTree
+from ..clustering.vptree import VPTree
+
+
+# --------------------------------------------------------------------------- shared
+
+def _hbeta(d_row: np.ndarray, beta: float):
+    """Entropy + probabilities for one row at precision beta
+    (``Tsne.java hBeta:143``)."""
+    p = np.exp(-d_row * beta)
+    sum_p = max(p.sum(), 1e-12)
+    h = np.log(sum_p) + beta * float(d_row @ p) / sum_p
+    return h, p / sum_p
+
+
+def binary_search_perplexity(d2: np.ndarray, perplexity: float,
+                             tol: float = 1e-5, max_tries: int = 50) -> np.ndarray:
+    """Per-row beta search to hit log(perplexity) entropy
+    (``computeGaussianPerplexity``, ``Tsne.java:261-428``)."""
+    n = d2.shape[0]
+    target = np.log(perplexity)
+    P = np.zeros_like(d2)
+    for i in range(n):
+        row = np.delete(d2[i], i)
+        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+        h, p = _hbeta(row, beta)
+        for _ in range(max_tries):
+            if abs(h - target) < tol:
+                break
+            if h > target:
+                beta_min = beta
+                beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+            else:
+                beta_max = beta
+                beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+            h, p = _hbeta(row, beta)
+        P[i, np.arange(n) != i] = p
+    return P
+
+
+@jax.jit
+def _pairwise_sq_dists(x):
+    s = jnp.sum(x * x, axis=1)
+    return jnp.maximum(s[:, None] - 2.0 * x @ x.T + s[None, :], 0.0)
+
+
+@jax.jit
+def _tsne_grad(y, P):
+    """Exact t-SNE gradient: 4 * sum_j (p_ij - q_ij) q*_ij (y_i - y_j)."""
+    d2 = _pairwise_sq_dists(y)
+    num = 1.0 / (1.0 + d2)
+    num = num * (1.0 - jnp.eye(y.shape[0]))
+    Q = jnp.maximum(num / jnp.sum(num), 1e-12)
+    PQ = (P - Q) * num
+    grad = 4.0 * ((jnp.diag(PQ.sum(axis=1)) - PQ) @ y)
+    kl = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12) / Q))
+    return grad, kl
+
+
+class Tsne:
+    """Exact t-SNE with momentum + per-element adaptive gains
+    (``Tsne.java`` step scheme)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 early_exaggeration: float = 4.0, exaggeration_iters: int = 100,
+                 seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.early_exaggeration = early_exaggeration
+        self.exaggeration_iters = exaggeration_iters
+        self.seed = seed
+        self.kl_: float = float("nan")
+
+    def _input_probs(self, x: np.ndarray) -> np.ndarray:
+        d2 = np.asarray(_pairwise_sq_dists(jnp.asarray(x, jnp.float32)))
+        P = binary_search_perplexity(d2, self.perplexity)
+        P = (P + P.T) / (2.0 * P.shape[0])
+        return np.maximum(P, 1e-12)
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        n = x.shape[0]
+        P = self._input_probs(x)
+        rng = np.random.default_rng(self.seed)
+        y = jnp.asarray(rng.normal(0, 1e-4, (n, self.n_components)), jnp.float32)
+        vel = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        Pj = jnp.asarray(P * self.early_exaggeration, jnp.float32)
+        for it in range(self.n_iter):
+            if it == self.exaggeration_iters:
+                Pj = Pj / self.early_exaggeration
+            grad, kl = _tsne_grad(y, Pj)
+            momentum = 0.5 if it < 250 else 0.8
+            gains = jnp.where(jnp.sign(grad) != jnp.sign(vel),
+                              gains + 0.2, gains * 0.8)
+            gains = jnp.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y = y - jnp.mean(y, axis=0)
+        self.kl_ = float(kl)
+        return np.asarray(y)
+
+
+class BarnesHutTsne(Tsne):
+    """theta-approximate t-SNE (``BarnesHutTsne.java:36``): sparse input
+    affinities from VP-tree kNN; repulsive forces via the quad tree."""
+
+    def __init__(self, theta: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def _sparse_input_probs(self, x: np.ndarray):
+        n = x.shape[0]
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(x)
+        rows, cols, vals = [], [], []
+        for i in range(n):
+            nbrs = [t for t in tree.knn(x[i], k + 1) if t[0] != i][:k]
+            idx = np.array([t[0] for t in nbrs])
+            d2 = np.array([t[1] for t in nbrs]) ** 2
+            beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+            target = np.log(self.perplexity)
+            for _ in range(50):
+                h, p = _hbeta(d2, beta)
+                if abs(h - target) < 1e-5:
+                    break
+                if h > target:
+                    beta_min = beta
+                    beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+                else:
+                    beta_max = beta
+                    beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+            rows.extend([i] * len(idx))
+            cols.extend(idx.tolist())
+            vals.extend(p.tolist())
+        P = {}
+        for r, c, v in zip(rows, cols, vals):
+            P[(r, c)] = P.get((r, c), 0.0) + v / (2.0 * n)
+            P[(c, r)] = P.get((c, r), 0.0) + v / (2.0 * n)
+        return P
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        P = self._sparse_input_probs(x)
+        rng = np.random.default_rng(self.seed)
+        y = rng.normal(0, 1e-4, (n, 2))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+        exagg = self.early_exaggeration
+        for it in range(self.n_iter):
+            if it == self.exaggeration_iters:
+                exagg = 1.0
+            tree = QuadTree.build(y)
+            rep = np.zeros_like(y)
+            sum_q = 0.0
+            for i in range(n):
+                f, sq = tree.compute_non_edge_forces(y[i], self.theta, i)
+                rep[i] = f
+                sum_q += sq
+            sum_q = max(sum_q, 1e-12)
+            attr = np.zeros_like(y)
+            for (i, j), p in P.items():
+                diff = y[i] - y[j]
+                q = 1.0 / (1.0 + diff @ diff)
+                attr[i] += exagg * p * q * diff
+            grad = 4.0 * (attr - rep / sum_q)
+            momentum = 0.5 if it < 250 else 0.8
+            gains = np.where(np.sign(grad) != np.sign(vel), gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y -= y.mean(axis=0)
+        return y

@@ -144,19 +144,12 @@ class Glove:
         return None if i < 0 else np.asarray(self.syn0[i])
 
     def similarity(self, w1: str, w2: str) -> float:
-        v1, v2 = self.get_word_vector(w1), self.get_word_vector(w2)
-        if v1 is None or v2 is None:
-            return 0.0
-        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
-        return float(v1 @ v2 / denom) if denom > 0 else 0.0
+        from .similarity import cosine
+        return cosine(self.get_word_vector(w1), self.get_word_vector(w2))
 
     def words_nearest(self, word: str, n: int = 10) -> list[str]:
+        from .similarity import nearest
         vec = self.get_word_vector(word)
         if vec is None:
             return []
-        syn0 = np.asarray(self.syn0)
-        sims = syn0 @ vec / np.maximum(
-            np.linalg.norm(syn0, axis=1) * np.linalg.norm(vec), 1e-12)
-        order = np.argsort(-sims)
-        return [self.vocab.word_at(int(i)) for i in order
-                if self.vocab.word_at(int(i)) != word][:n]
+        return nearest(np.asarray(self.syn0), vec, self.vocab.word_at, n, {word})

@@ -87,22 +87,15 @@ class ParagraphVectors(Word2Vec):
         return None if i is None else np.asarray(self.doc_vectors[i])
 
     def doc_similarity(self, l1: str, l2: str) -> float:
-        v1, v2 = self.get_doc_vector(l1), self.get_doc_vector(l2)
-        if v1 is None or v2 is None:
-            return 0.0
-        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
-        return float(v1 @ v2 / denom) if denom > 0 else 0.0
+        from .similarity import cosine
+        return cosine(self.get_doc_vector(l1), self.get_doc_vector(l2))
 
     def docs_nearest(self, label: str, n: int = 10) -> list[str]:
+        from .similarity import nearest
         vec = self.get_doc_vector(label)
         if vec is None:
             return []
-        dv = np.asarray(self.doc_vectors)
-        sims = dv @ vec / np.maximum(
-            np.linalg.norm(dv, axis=1) * np.linalg.norm(vec), 1e-12)
-        order = np.argsort(-sims)
-        return [self.labels[int(i)] for i in order
-                if self.labels[int(i)] != label][:n]
+        return nearest(np.asarray(self.doc_vectors), vec, self.labels, n, {label})
 
     def infer_vector(self, text: str, steps: int = 50,
                      alpha: float = 0.025) -> np.ndarray:

@@ -90,6 +90,9 @@ class VocabCache:
 def build_vocab(sentences: Iterable[str], tokenizer_factory, min_word_frequency: float = 1.0,
                 use_native: bool = True) -> VocabCache:
     """One-pass vocab build (replaces the reference's VocabActor pipeline)."""
+    # materialize once: the native attempt may consume and then reject the
+    # corpus (e.g. non-ASCII), and the fallback must see the same sentences
+    sentences = list(sentences)
     cache = VocabCache()
     if use_native:
         try:

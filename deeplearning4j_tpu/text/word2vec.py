@@ -141,12 +141,13 @@ class Word2Vec:
         """Tokenize sentences to pruned index arrays, with subsampling."""
         out = []
         total = self.vocab.total_word_count
+        counts = self.vocab.counts_array() if self.sample > 0 else None
         for s in self.sentences:
             toks = self.tokenizer_factory.create(s).get_tokens()
             idx = [self.vocab.index_of(t) for t in toks]
             idx = np.array([i for i in idx if i >= 0], np.int32)
             if self.sample > 0 and idx.size:
-                freqs = self.vocab.counts_array()[idx] / total
+                freqs = counts[idx] / total
                 keep_p = np.minimum(1.0, np.sqrt(self.sample / freqs)
                                     + self.sample / freqs)
                 idx = idx[rng.random(idx.size) < keep_p]
@@ -244,13 +245,11 @@ class Word2Vec:
         return self.vocab is not None and word in self.vocab
 
     def similarity(self, w1: str, w2: str) -> float:
-        v1, v2 = self.get_word_vector(w1), self.get_word_vector(w2)
-        if v1 is None or v2 is None:
-            return 0.0
-        denom = np.linalg.norm(v1) * np.linalg.norm(v2)
-        return float(v1 @ v2 / denom) if denom > 0 else 0.0
+        from .similarity import cosine
+        return cosine(self.get_word_vector(w1), self.get_word_vector(w2))
 
     def words_nearest(self, word_or_vec, n: int = 10) -> list[str]:
+        from .similarity import nearest
         if isinstance(word_or_vec, str):
             vec = self.get_word_vector(word_or_vec)
             exclude = {word_or_vec}
@@ -258,18 +257,7 @@ class Word2Vec:
                 return []
         else:
             vec, exclude = np.asarray(word_or_vec), set()
-        syn0 = np.asarray(self.syn0)
-        norms = np.linalg.norm(syn0, axis=1) * (np.linalg.norm(vec) + 1e-12)
-        sims = syn0 @ vec / np.maximum(norms, 1e-12)
-        order = np.argsort(-sims)
-        out = []
-        for i in order:
-            w = self.vocab.word_at(int(i))
-            if w not in exclude:
-                out.append(w)
-            if len(out) >= n:
-                break
-        return out
+        return nearest(np.asarray(self.syn0), vec, self.vocab.word_at, n, exclude)
 
     def accuracy(self, analogies: Sequence[tuple[str, str, str, str]]) -> float:
         """a:b :: c:d analogy accuracy (reference ``accuracy`` API)."""

@@ -1,5 +1,11 @@
 """Shared host-side utilities (reference: ``util/*``, ``berkeley/*``)."""
 
-from . import tree_math
+from . import counters, misc, tree_math, viterbi
+from .counters import Counter, CounterMap, Index
+from .misc import DiskBasedQueue, SummaryStatistics
+from .viterbi import Viterbi, viterbi_decode
 
-__all__ = ["tree_math"]
+__all__ = ["counters", "misc", "tree_math", "viterbi",
+           "Counter", "CounterMap", "Index",
+           "DiskBasedQueue", "SummaryStatistics",
+           "Viterbi", "viterbi_decode"]

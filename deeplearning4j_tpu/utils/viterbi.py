@@ -1,0 +1,57 @@
+"""Viterbi sequence decoder.
+
+Capability match of ``util/Viterbi.java:15,47-57``: most-likely label
+sequence given per-step emission scores and a transition matrix.  The DP
+recursion runs under ``lax.scan`` (device-friendly) with host argmax
+traceback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def viterbi_decode(emissions, transitions, initial=None):
+    """emissions: (T, S) log scores; transitions: (S, S) log p(j <- i).
+
+    Returns (path indices (T,), best log score)."""
+    emissions = jnp.asarray(emissions, jnp.float32)
+    transitions = jnp.asarray(transitions, jnp.float32)
+    T, S = emissions.shape
+    init = (jnp.zeros((S,), jnp.float32) if initial is None
+            else jnp.asarray(initial, jnp.float32))
+
+    def step(prev_scores, emit):
+        scores = prev_scores[:, None] + transitions + emit[None, :]
+        best_prev = jnp.argmax(scores, axis=0)
+        new_scores = jnp.max(scores, axis=0)
+        return new_scores, best_prev
+
+    first = init + emissions[0]
+    final_scores, backptrs = jax.lax.scan(step, first, emissions[1:])
+    path = np.zeros(T, np.int64)
+    path[-1] = int(jnp.argmax(final_scores))
+    bp = np.asarray(backptrs)
+    for t in range(T - 2, -1, -1):
+        path[t] = bp[t, path[t + 1]]
+    return path, float(jnp.max(final_scores))
+
+
+class Viterbi:
+    """Binary-label decoder over window predictions (the reference decodes
+    word-window label sequences with a fixed switching penalty)."""
+
+    def __init__(self, possible_labels, transition_prob: float = 0.95):
+        self.labels = list(possible_labels)
+        s = len(self.labels)
+        stay = np.log(transition_prob)
+        switch = np.log(max(1e-12, (1 - transition_prob) / max(1, s - 1)))
+        self.transitions = np.full((s, s), switch)
+        np.fill_diagonal(self.transitions, stay)
+
+    def decode(self, emission_probs) -> list:
+        em = np.log(np.maximum(np.asarray(emission_probs, np.float64), 1e-12))
+        path, _ = viterbi_decode(em, self.transitions)
+        return [self.labels[i] for i in path]

@@ -1,0 +1,102 @@
+"""Clustering + visualization tests (mirror of the reference's TsneTest and
+clustering/{kdtree,quadtree,vptree} tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, QuadTree, VPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def three_blobs(n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], np.float64)
+    pts = np.concatenate([rng.normal(c, 0.5, (n, 2)) for c in centers])
+    labels = np.repeat([0, 1, 2], n)
+    return pts, labels
+
+
+def test_kmeans_recovers_blobs():
+    pts, labels = three_blobs()
+    km = KMeansClustering(k=3, seed=1).fit(pts)
+    assign = km.labels()
+    # each true cluster maps to exactly one k-means cluster
+    for c in range(3):
+        vals, counts = np.unique(assign[labels == c], return_counts=True)
+        assert counts.max() / counts.sum() > 0.95
+    assert km.predict(pts[:5]).shape == (5,)
+
+
+def test_kdtree_nearest_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pts = rng.random((200, 4))
+    tree = KDTree(pts)
+    for _ in range(20):
+        q = rng.random(4)
+        idx, dist = tree.nearest(q)
+        brute = np.linalg.norm(pts - q, axis=1)
+        assert idx == int(brute.argmin())
+        assert dist == pytest.approx(float(brute.min()))
+    knn = tree.knn(pts[0], 5)
+    brute_order = np.argsort(np.linalg.norm(pts - pts[0], axis=1))[:5]
+    assert {i for i, _ in knn} == set(brute_order.tolist())
+
+
+def test_kdtree_range_search():
+    pts = np.array([[0, 0], [1, 1], [2, 2], [5, 5]], np.float64)
+    tree = KDTree(pts)
+    assert tree.range_search([0.5, 0.5], [2.5, 2.5]) == [1, 2]
+
+
+def test_vptree_knn_matches_bruteforce():
+    rng = np.random.default_rng(4)
+    pts = rng.random((150, 6))
+    tree = VPTree(pts)
+    for _ in range(10):
+        q = rng.random(6)
+        got = {i for i, _ in tree.knn(q, 7)}
+        brute = set(np.argsort(np.linalg.norm(pts - q, axis=1))[:7].tolist())
+        assert got == brute
+
+
+def test_quadtree_structure_and_com():
+    pts, _ = three_blobs(n=20)
+    tree = QuadTree.build(pts)
+    assert tree.size == pts.shape[0]
+    np.testing.assert_allclose(tree.com, pts.mean(axis=0), atol=1e-9)
+    assert tree.depth() >= 2
+    f, sq = tree.compute_non_edge_forces(pts[0], theta=0.5, index=0)
+    assert np.all(np.isfinite(f)) and sq > 0
+
+
+def test_exact_tsne_separates_blobs():
+    pts, labels = three_blobs(n=20, seed=5)
+    emb = Tsne(perplexity=10, n_iter=250, seed=0).fit_transform(pts)
+    assert emb.shape == (60, 2)
+    # clusters should be separated: within-cluster dist << between-cluster
+    within = np.mean([np.linalg.norm(emb[labels == c] - emb[labels == c].mean(0), axis=1).mean()
+                      for c in range(3)])
+    centers = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    between = np.mean([np.linalg.norm(centers[i] - centers[j])
+                       for i in range(3) for j in range(i + 1, 3)])
+    assert between > 3 * within
+
+
+def test_barnes_hut_tsne_runs():
+    pts, labels = three_blobs(n=12, seed=6)
+    emb = BarnesHutTsne(theta=0.5, perplexity=8, n_iter=120, seed=0).fit_transform(pts)
+    assert emb.shape == (36, 2)
+    assert np.all(np.isfinite(emb))
+
+
+def test_renderers(tmp_path):
+    from deeplearning4j_tpu.plot import FilterRenderer, NeuralNetPlotter, draw_mnist_grid
+    rng = np.random.default_rng(0)
+    params = [{"W": rng.random((16, 4)), "b": rng.random(4)}]
+    grads = [{"W": rng.random((16, 4)), "b": rng.random(4)}]
+    files = NeuralNetPlotter().plot_network_gradient(params, grads, tmp_path)
+    assert files and files[0].exists()
+    p = FilterRenderer().render_filters(rng.random((16, 9)), tmp_path / "f.png")
+    assert p.exists()
+    p2 = draw_mnist_grid(rng.random((12, 64)), tmp_path / "g.png")
+    assert p2.exists()
