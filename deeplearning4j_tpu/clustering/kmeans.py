@@ -48,11 +48,14 @@ class KMeansClustering:
         centroids = pts[jnp.asarray(init_idx)]
         prev = float("inf")
         for _ in range(self.max_iterations):
-            centroids, assign, inertia = _lloyd_step(pts, centroids, self.k)
+            centroids, _, inertia = _lloyd_step(pts, centroids, self.k)
             cur = float(inertia)
             if abs(prev - cur) < self.tol * max(1.0, abs(prev)):
                 break
             prev = cur
+        # final assignment/inertia against the FINAL centroids (the loop's
+        # values lag one update behind), so labels() agrees with predict()
+        _, assign, inertia = _lloyd_step(pts, centroids, self.k)
         self.centroids = np.asarray(centroids)
         self.inertia = float(inertia)
         self._assign = np.asarray(assign)

@@ -10,10 +10,14 @@ from __future__ import annotations
 import numpy as np
 
 
-class QuadTree:
-    __slots__ = ("center", "half", "com", "size", "children", "point", "index")
+MAX_DEPTH = 48  # duplicates/near-duplicates stop subdividing past this
 
-    def __init__(self, center, half):
+
+class QuadTree:
+    __slots__ = ("center", "half", "com", "size", "children", "point",
+                 "index", "depth_")
+
+    def __init__(self, center, half, depth: int = 0):
         self.center = np.asarray(center, np.float64)
         self.half = float(half)
         self.com = np.zeros(2)
@@ -21,6 +25,7 @@ class QuadTree:
         self.children: list[QuadTree] | None = None
         self.point = None
         self.index = -1
+        self.depth_ = depth
 
     # ------------------------------------------------------------------ build
     @classmethod
@@ -44,6 +49,11 @@ class QuadTree:
         if self.size == 1:
             self.point, self.index = p, index
             return
+        # duplicate/near-duplicate guard: past MAX_DEPTH the cell only
+        # aggregates (com + size), which is all Barnes-Hut needs — without
+        # this, two identical points recurse forever
+        if self.depth_ >= MAX_DEPTH:
+            return
         if self.children is None:
             self._subdivide()
             if self.point is not None:
@@ -54,7 +64,7 @@ class QuadTree:
     def _subdivide(self):
         h = self.half / 2
         cx, cy = self.center
-        self.children = [QuadTree((cx + dx * h, cy + dy * h), h)
+        self.children = [QuadTree((cx + dx * h, cy + dy * h), h, self.depth_ + 1)
                          for dx in (-1, 1) for dy in (-1, 1)]
 
     def _child_for(self, p) -> "QuadTree":

@@ -69,14 +69,22 @@ class LSTMSequenceModel:
         d = self.conf.hidden_size or self.conf.n_out
         return (jnp.zeros((d,)), jnp.zeros((d,)))
 
+    def _prime(self, prime: list[int]):
+        """Carry + log-probs after consuming ``prime`` (possibly empty: the
+        zero hidden state's decoder distribution seeds generation)."""
+        carry = self._init_carry()
+        h0 = carry[0]
+        logits = h0 @ self.params["decoderweights"] + self.params["decoderbias"]
+        logp = np.asarray(jax.nn.log_softmax(logits))
+        for t in prime:
+            carry, logp = self._step_logits(carry, t)
+        return carry, logp
+
     def sample(self, prime: list[int], length: int, temperature: float = 1.0,
                seed: int = 0) -> list[int]:
         """Temperature sampling continuation of ``prime``."""
         rng = np.random.default_rng(seed)
-        carry = self._init_carry()
-        logp = None
-        for t in prime:
-            carry, logp = self._step_logits(carry, t)
+        carry, logp = self._prime(prime)
         out = list(prime)
         for _ in range(length):
             p = np.exp(logp / temperature)
@@ -91,10 +99,7 @@ class LSTMSequenceModel:
         """Highest-log-likelihood continuation (``LSTM.java BeamSearch``).
 
         Returns (token sequence, total log prob)."""
-        carry = self._init_carry()
-        logp = None
-        for t in prime:
-            carry, logp = self._step_logits(carry, t)
+        carry, logp = self._prime(prime)
         beams = [(0.0, list(prime), carry, logp)]
         for _ in range(length):
             candidates = []
@@ -112,8 +117,5 @@ class LSTMSequenceModel:
         return best[1], best[0]
 
     def predict_next(self, prime: list[int]) -> int:
-        carry = self._init_carry()
-        logp = None
-        for t in prime:
-            carry, logp = self._step_logits(carry, t)
+        carry, logp = self._prime(prime)
         return int(np.argmax(logp))

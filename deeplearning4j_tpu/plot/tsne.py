@@ -32,6 +32,25 @@ def _hbeta(d_row: np.ndarray, beta: float):
     return h, p / sum_p
 
 
+def _search_beta(d_row: np.ndarray, target: float, tol: float = 1e-5,
+                 max_tries: int = 50) -> np.ndarray:
+    """Bisect the precision beta until the row's entropy hits ``target``;
+    returns the row's conditional probabilities (shared by exact and BH)."""
+    beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+    h, p = _hbeta(d_row, beta)
+    for _ in range(max_tries):
+        if abs(h - target) < tol:
+            break
+        if h > target:
+            beta_min = beta
+            beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+        else:
+            beta_max = beta
+            beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+        h, p = _hbeta(d_row, beta)
+    return p
+
+
 def binary_search_perplexity(d2: np.ndarray, perplexity: float,
                              tol: float = 1e-5, max_tries: int = 50) -> np.ndarray:
     """Per-row beta search to hit log(perplexity) entropy
@@ -41,19 +60,7 @@ def binary_search_perplexity(d2: np.ndarray, perplexity: float,
     P = np.zeros_like(d2)
     for i in range(n):
         row = np.delete(d2[i], i)
-        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
-        h, p = _hbeta(row, beta)
-        for _ in range(max_tries):
-            if abs(h - target) < tol:
-                break
-            if h > target:
-                beta_min = beta
-                beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
-            else:
-                beta_max = beta
-                beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
-            h, p = _hbeta(row, beta)
-        P[i, np.arange(n) != i] = p
+        P[i, np.arange(n) != i] = _search_beta(row, target, tol, max_tries)
     return P
 
 
@@ -140,18 +147,7 @@ class BarnesHutTsne(Tsne):
             nbrs = [t for t in tree.knn(x[i], k + 1) if t[0] != i][:k]
             idx = np.array([t[0] for t in nbrs])
             d2 = np.array([t[1] for t in nbrs]) ** 2
-            beta, beta_min, beta_max = 1.0, -np.inf, np.inf
-            target = np.log(self.perplexity)
-            for _ in range(50):
-                h, p = _hbeta(d2, beta)
-                if abs(h - target) < 1e-5:
-                    break
-                if h > target:
-                    beta_min = beta
-                    beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
-                else:
-                    beta_max = beta
-                    beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+            p = _search_beta(d2, np.log(self.perplexity))
             rows.extend([i] * len(idx))
             cols.extend(idx.tolist())
             vals.extend(p.tolist())

@@ -213,6 +213,6 @@ def unzip_file_to(archive: str | Path, dest: str | Path) -> None:
     elif name.endswith((".tar.gz", ".tgz", ".tar")):
         mode = "r:gz" if name.endswith(("gz", "tgz")) else "r"
         with tarfile.open(archive, mode) as t:
-            t.extractall(dest)
+            t.extractall(dest, filter="data")  # block tar-slip traversal
     else:
         raise ValueError(f"unknown archive format: {name}")

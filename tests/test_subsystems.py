@@ -1,0 +1,176 @@
+"""Subsystem parity tests: RNTN, trees, inverted index, windows, sentiment,
+record readers, observability, storage/config registry."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.rntn import RNTN, linearize
+from deeplearning4j_tpu.text.tree import Tree, binarize, parse_sexpr, right_branching
+from deeplearning4j_tpu.text.index import InvertedIndex
+from deeplearning4j_tpu.text.windows import PAD, Window, window_matrix, windows
+from deeplearning4j_tpu.text.sentiwordnet import SentiWordNet
+from deeplearning4j_tpu.datasets.records import (
+    CollectionRecordReader,
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.parallel.observe import MetricsRegistry, StatusServer, StepTimer
+from deeplearning4j_tpu.parallel.scaleout import StateTracker
+from deeplearning4j_tpu.parallel.storage import (
+    ConfigRegistry,
+    LocalArtifactStore,
+    StoreModelSaver,
+)
+
+
+# --------------------------------------------------------------------------- trees
+
+def test_sexpr_parse_roundtrip():
+    t = parse_sexpr("(3 (2 nice) (1 (0 not) (2 movie)))")
+    assert t.gold_label == 3
+    assert t.words() == ["nice", "not", "movie"]
+    assert t.depth() >= 3
+    assert "(3" in t.to_sexpr()
+
+
+def test_binarize_and_right_branching():
+    t = parse_sexpr("(1 (0 a) (0 b) (0 c) (0 d))")
+    b = binarize(t)
+    for node in b.subtrees():
+        # pre-terminals (tag -> word) stay unary, as in treebank convention
+        assert node.is_leaf() or node.is_pre_terminal() or len(node.children) == 2
+    rb = right_branching(["x", "y", "z"], label=1)
+    assert rb.words() == ["x", "y", "z"]
+    assert rb.gold_label == 1
+
+
+def test_rntn_learns_toy_sentiment():
+    """Positive trees contain 'good', negative contain 'bad' — root
+    classification should become near-perfect."""
+    pos = [right_branching(f"this movie is good {w}".split(), label=1)
+           for w in ["really", "very", "so", "quite"]]
+    neg = [right_branching(f"this movie is bad {w}".split(), label=0)
+           for w in ["really", "very", "so", "quite"]]
+    trees = pos + neg
+    model = RNTN(layer_size=12, n_classes=2, max_nodes=16, lr=0.1, seed=1)
+    losses = model.fit(trees, epochs=60, batch_size=8)
+    assert losses[-1] < losses[0]
+    assert model.accuracy(trees) >= 0.9
+    preds = model.predict_tree(trees[0])
+    assert preds.shape[0] == int(np.sum(
+        linearize(trees[0], model.vocab, 16).mask))
+
+
+def test_inverted_index():
+    ix = InvertedIndex()
+    ix.add_all(["the cat sat", "the dog ran", "a cat and a dog"])
+    assert ix.num_documents() == 3
+    assert ix.documents_for("cat") == [0, 2]
+    assert ix.doc_frequency("dog") == 2
+    hits = ix.search("cat")
+    assert hits and hits[0][0] in (0, 2)
+    batches = list(ix.batch_iter(2))
+    assert len(batches) == 2 and len(batches[0]) == 2
+
+
+def test_windows():
+    ws = windows(["a", "b", "c"], window_size=3, labels=["x", "y", "z"])
+    assert len(ws) == 3
+    assert ws[0].words == [PAD, "a", "b"] and ws[0].focus == "a"
+    assert ws[2].label == "z"
+    m = window_matrix(ws[0], lambda w: np.ones(2) if w == "a" else None, 2)
+    assert m.shape == (6,)
+    assert m[2:4].tolist() == [1.0, 1.0]
+
+
+def test_sentiwordnet_seed_and_file(tmp_path):
+    swn = SentiWordNet()
+    assert swn.classify("this is a good great movie".split()) in (
+        "positive", "strong_positive")
+    assert swn.classify("terrible awful hate".split()) == "strong_negative"
+    p = tmp_path / "swn.txt"
+    p.write_text("# comment\na\t1\t0.75\t0.0\tsplendid#1\tgloss\n")
+    swn2 = SentiWordNet(p)
+    assert swn2.score("splendid") == pytest.approx(0.75)
+
+
+def test_record_readers(tmp_path):
+    p = tmp_path / "r.csv"
+    p.write_text("1.0,2.0,cat\n3.0,4.0,dog\n5.0,6.0,cat\n")
+    rr = CSVRecordReader(p)
+    it = RecordReaderDataSetIterator(rr, batch=2, label_index=2)
+    ds = it.next()
+    assert ds.features.shape == (2, 2)
+    assert it.total_outcomes() == 2
+    rr2 = CollectionRecordReader([[0.1, 0.2], [0.3, 0.4]])
+    unsup = RecordReaderDataSetIterator(rr2, batch=2, label_index=None)
+    b = unsup.next()
+    np.testing.assert_array_equal(b.features, b.labels)
+
+
+def test_metrics_registry_and_step_timer():
+    reg = MetricsRegistry()
+    reg.increment("x")
+    reg.increment("x", 2)
+    reg.gauge("g", 3.5)
+    with reg.time("op"):
+        pass
+    snap = reg.snapshot()
+    assert snap["counters"]["x"] == 3
+    assert snap["gauges"]["g"] == 3.5
+    assert snap["timers"]["op"]["count"] == 1
+
+    class FakeModel:
+        def score(self):
+            return 1.25
+
+    timer = StepTimer(reg, "step")
+    timer.iteration_done(FakeModel(), 1)
+    timer.iteration_done(FakeModel(), 2)
+    assert reg.snapshot()["counters"]["step.iterations"] == 2
+    assert reg.snapshot()["gauges"]["step.score"] == 1.25
+
+
+def test_status_server_endpoints():
+    tracker = StateTracker()
+    tracker.add_worker("w0")
+    tracker.increment("jobs", 4)
+    reg = MetricsRegistry()
+    reg.increment("steps", 7)
+    srv = StatusServer(tracker, reg).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        health = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert health == {"ok": True}
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status["workers"] == ["w0"]
+        assert status["counters"]["jobs"] == 4
+        metrics = json.loads(urllib.request.urlopen(base + "/metrics").read())
+        assert metrics["counters"]["steps"] == 7
+    finally:
+        srv.stop()
+
+
+def test_local_store_and_registry(tmp_path):
+    store = LocalArtifactStore(tmp_path)
+    store.put_bytes("a/b.bin", b"hello")
+    assert store.exists("a/b.bin")
+    assert store.get_bytes("a/b.bin") == b"hello"
+    assert store.list() == ["a/b.bin"]
+    with pytest.raises(ValueError):
+        store.put_bytes("../escape", b"x")
+
+    saver = StoreModelSaver(store, "m.pkl")
+    saver.save({"w": [1, 2]})
+    assert saver.load() == {"w": [1, 2]}
+
+    reg = ConfigRegistry(store)
+    reg.register("host1", "training", {"lr": 0.1})
+    assert reg.exists("host1", "training")
+    assert reg.retrieve("host1", "training") == {"lr": 0.1}
+    assert reg.hosts() == ["host1"]
+    reg.unregister("host1", "training")
+    assert not reg.exists("host1", "training")
