@@ -72,21 +72,24 @@ def main():
                                 n_layers=2, d_ff=256, max_len=seq,
                                 causal=False, dtype=jnp.float32, remat=False)
 
+    from deeplearning4j_tpu.optimize import transforms as T
+
     model = TransformerLM(cfg)
     with jax.default_device(dev):
+        tx = T.adamw(T.warmup_cosine(1e-4, 10, 1000), weight_decay=0.01)
         params = model.init(jax.random.key(0))
-        mom = model.init_momentum(params)
+        opt = model.init_opt(params, tx)
         tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                     cfg.vocab_size)
         targets = jnp.roll(tokens, -1, axis=1)
-        step = model.build_train_step(lr=1e-3)
+        step = model.build_train_step(tx)
 
         # compile + warmup
-        params, mom, loss = step(params, mom, tokens, targets)
+        params, opt, loss = step(params, opt, tokens, targets)
         jax.block_until_ready(loss)
         t0 = time.time()
         for _ in range(iters):
-            params, mom, loss = step(params, mom, tokens, targets)
+            params, opt, loss = step(params, opt, tokens, targets)
         jax.block_until_ready(loss)
         dt = time.time() - t0
 

@@ -278,11 +278,11 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
     return x + down.astype(x.dtype)
 
 
-def forward_local(params, tokens, cfg: TransformerConfig, *,
-                  n_sp: int = 1, sp_axis: str | None = None,
-                  tp_axis: str | None = None) -> jnp.ndarray:
-    """Logits for local token shard (B_loc, T_loc) — runs inside shard_map
-    (or standalone when all axes are trivial)."""
+def encode_local(params, tokens, cfg: TransformerConfig, *,
+                 n_sp: int = 1, sp_axis: str | None = None,
+                 tp_axis: str | None = None) -> jnp.ndarray:
+    """Final hidden states (B_loc, T_loc, D) for the local token shard —
+    runs inside shard_map (or standalone when all axes are trivial)."""
     B, T = tokens.shape
     my_sp = lax.axis_index(sp_axis) if sp_axis else 0
     pos0 = my_sp * T
@@ -296,7 +296,15 @@ def forward_local(params, tokens, cfg: TransformerConfig, *,
     for lp in params["layers"]:
         x = block(lp, x, cfg, n_sp, sp_axis, tp_axis, T)
 
-    x = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    return _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+
+
+def forward_local(params, tokens, cfg: TransformerConfig, *,
+                  n_sp: int = 1, sp_axis: str | None = None,
+                  tp_axis: str | None = None) -> jnp.ndarray:
+    """Vocabulary logits for the local token shard."""
+    x = encode_local(params, tokens, cfg, n_sp=n_sp, sp_axis=sp_axis,
+                     tp_axis=tp_axis)
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("btd,dv->btv", x.astype(cfg.dtype), head.astype(cfg.dtype))
     return logits.astype(jnp.float32)
@@ -309,6 +317,36 @@ def lm_loss_local(params, tokens, targets, cfg: TransformerConfig, **axes):
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def init_cls_head(key, cfg: TransformerConfig, n_classes: int):
+    """Sequence-classification head (the BERT fine-tune north star): mean
+    pooling → dense.  Mean pooling (not [CLS]) so the pooled vector is an
+    sp-pmean away from correct under sequence parallelism."""
+    w = (cfg.d_model ** -0.5 * jax.random.normal(
+        key, (cfg.d_model, n_classes))).astype(cfg.param_dtype)
+    return {"w_cls": w, "b_cls": jnp.zeros((n_classes,), cfg.param_dtype)}
+
+
+def cls_head_specs():
+    return {"w_cls": P(), "b_cls": P()}
+
+
+def cls_loss_local(params, head, tokens, labels, cfg: TransformerConfig, *,
+                   n_sp: int = 1, sp_axis: str | None = None,
+                   tp_axis: str | None = None):
+    """Softmax cross entropy of the pooled classifier on the local shard.
+
+    Pooling: local mean over T_loc, then pmean over sp — equal shard sizes
+    make that the exact global sequence mean."""
+    x = encode_local(params, tokens, cfg, n_sp=n_sp, sp_axis=sp_axis,
+                     tp_axis=tp_axis)
+    pooled = x.astype(jnp.float32).mean(axis=1)
+    if sp_axis:
+        pooled = lax.pmean(pooled, sp_axis)
+    logits = pooled @ head["w_cls"].astype(jnp.float32) + head["b_cls"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
 
 
 # --------------------------------------------------------------------------- model facade
@@ -338,63 +376,132 @@ class TransformerLM:
         s = self.mesh.shape
         return s.get(DP, 1), s.get(SP, 1), s.get(TP, 1)
 
-    def build_train_step(self, lr: float = 1e-3):
-        """SGD-with-momentum train step, fully sharded.  Returns
-        ``step(params, mom, tokens, targets) -> (params, mom, loss)``;
-        for mesh=None a plain jitted single-device step."""
-        cfg = self.cfg
+    @staticmethod
+    def _default_tx(lr: float):
+        """SGD-with-momentum, the reference's finetune default
+        (``BaseOptimizer.java:68-118`` momentum seam)."""
+        from ..optimize import transforms as T
+        return T.chain(T.momentum(0.9), T.sgd_lr(lr))
+
+    def _is_finetune_tree(self, tree):
+        return isinstance(tree, dict) and set(tree.keys()) == {"backbone", "head"}
+
+    def init_opt(self, params, tx=None, lr: float = 1e-3, specs=None):
+        """Optimizer state for ``build_train_step``/``build_finetune_step``:
+        ``(step_count, tx_state)``, placed onto the mesh with tx-declared
+        PartitionSpecs.  Works for both the plain param tree and the
+        ``{"backbone", "head"}`` finetune tree (specs inferred; pass
+        ``specs`` explicitly for custom trees)."""
+        tx = tx if tx is not None else self._default_tx(lr)
+        state = (jnp.zeros((), jnp.int32), tx.init(params))
+        if self.mesh is None:
+            return state
+        if specs is None:
+            specs = (self.finetune_specs() if self._is_finetune_tree(params)
+                     else param_specs(self.cfg))
+        return self.place(state, self.opt_specs(tx, specs))
+
+    def opt_specs(self, tx, params_specs=None):
+        ps = params_specs if params_specs is not None else param_specs(self.cfg)
+        spec_fn = tx.state_spec or (lambda _: ())
+        return (P(), spec_fn(ps))
+
+    def _grad_sync(self, specs, sp_axis, tp_axis):
+        """Cross-replica gradient pmean over every axis a param is
+        REPLICATED on (dp+sp always; tp for tp-replicated leaves)."""
+
+        def sync(g, spec):
+            g = lax.pmean(g, DP)
+            if sp_axis:
+                g = lax.pmean(g, SP)
+            sharded_on_tp = any(ax == TP for ax in spec if ax is not None)
+            if tp_axis and not sharded_on_tp:
+                g = lax.pmean(g, TP)
+            return g
+
+        return lambda grads: jax.tree_util.tree_map(
+            sync, grads, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def _build_step(self, tx, loss_of, specs, data_specs):
+        """Shared step builder: ``loss_of(tree, *data, axes)`` differs per
+        objective; everything else (grad, cross-replica sync, transform
+        chain, shard_map wrapper) is identical.  Replaces the reference's
+        ``Solver``→``BaseOptimizer.optimize`` dispatch for the flagship."""
+        from ..optimize.transforms import apply_updates
         n_dp, n_sp, n_tp = self._axes()
-        mu = 0.9
 
         if self.mesh is None:
-            def simple(params, mom, tokens, targets):
+            def simple(tree, opt, *data):
+                count, tx_state = opt
                 loss, g = jax.value_and_grad(
-                    lambda p: lm_loss_local(p, tokens, targets, cfg))(params)
-                mom2 = jax.tree_util.tree_map(lambda m, gg: mu * m + gg, mom, g)
-                params = jax.tree_util.tree_map(
-                    lambda p, m: p - lr * m.astype(p.dtype), params, mom2)
-                return params, mom2, loss
+                    lambda t: loss_of(t, *data, axes={}))(tree)
+                updates, tx_state = tx.update(g, tx_state, tree, count)
+                tree = apply_updates(tree, updates)
+                return tree, (count + 1, tx_state), loss
             return jax.jit(simple, donate_argnums=(0, 1))
 
-        specs = param_specs(cfg)
-        data_spec = P(DP, SP)
+        opt_spec = self.opt_specs(tx, specs)
         sp_axis = SP if n_sp > 1 else None
         tp_axis = TP if n_tp > 1 else None
+        sync = self._grad_sync(specs, sp_axis, tp_axis)
+        axes = dict(n_sp=n_sp, sp_axis=sp_axis, tp_axis=tp_axis)
 
-        def local_step(params, mom, tokens, targets):
-            def loss_fn(p):
-                return lm_loss_local(p, tokens, targets, cfg,
-                                     n_sp=n_sp, sp_axis=sp_axis, tp_axis=tp_axis)
-            loss, grads = jax.value_and_grad(loss_fn)(params)
-            # cross-replica reductions: loss everywhere; grads over the axes
-            # each param is REPLICATED on (dp+sp always; tp too for
-            # tp-replicated leaves).
+        def local_step(tree, opt, *data):
+            count, tx_state = opt
+            loss, grads = jax.value_and_grad(
+                lambda t: loss_of(t, *data, axes=axes))(tree)
             loss = lax.pmean(lax.pmean(loss, DP), SP) if sp_axis else lax.pmean(loss, DP)
-
-            def sync(g, spec):
-                g = lax.pmean(g, DP)
-                if sp_axis:
-                    g = lax.pmean(g, SP)
-                sharded_on_tp = any(ax == TP for ax in spec if ax is not None)
-                if tp_axis and not sharded_on_tp:
-                    g = lax.pmean(g, TP)
-                return g
-
-            grads = jax.tree_util.tree_map(
-                sync, grads, specs,
-                is_leaf=lambda x: isinstance(x, P))
-            mom2 = jax.tree_util.tree_map(lambda m, g: mu * m + g, mom, grads)
-            params = jax.tree_util.tree_map(
-                lambda p, m: p - lr * m.astype(p.dtype), params, mom2)
-            return params, mom2, loss
+            grads = sync(grads)
+            updates, tx_state = tx.update(grads, tx_state, tree, count)
+            tree = apply_updates(tree, updates)
+            return tree, (count + 1, tx_state), loss
 
         smapped = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(specs, specs, data_spec, data_spec),
-            out_specs=(specs, specs, P()),
+            in_specs=(specs, opt_spec) + data_specs,
+            out_specs=(specs, opt_spec, P()),
             check_vma=False,
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
+
+    def build_train_step(self, tx=None, lr: float = 1e-3):
+        """LM train step over any ``GradientTransform`` (default: the
+        reference's SGD+momentum).  Returns
+        ``step(params, opt, tokens, targets) -> (params, opt, loss)`` where
+        ``opt = (step_count, tx_state)``."""
+        cfg = self.cfg
+        tx = tx if tx is not None else self._default_tx(lr)
+
+        def loss_of(params, tokens, targets, axes):
+            return lm_loss_local(params, tokens, targets, cfg, **axes)
+
+        return self._build_step(tx, loss_of, param_specs(cfg),
+                                (P(DP, SP), P(DP, SP)))
+
+    # -- BERT-style sequence-classification fine-tune -------------------
+    def init_finetune(self, key, n_classes: int, params=None):
+        """(backbone, head) combined tree for ``build_finetune_step``."""
+        backbone = params if params is not None else self.init(key)
+        head = init_cls_head(jax.random.fold_in(key, 1), self.cfg, n_classes)
+        tree = {"backbone": backbone, "head": head}
+        return self.place(tree, self.finetune_specs()) if self.mesh else tree
+
+    def finetune_specs(self):
+        return {"backbone": param_specs(self.cfg), "head": cls_head_specs()}
+
+    def build_finetune_step(self, tx=None, lr: float = 2e-5):
+        """Classifier fine-tune step (north star: BERT-base fine-tune).
+        ``step(tree, opt, tokens, labels) -> (tree, opt, loss)`` with
+        ``tree = {"backbone": ..., "head": ...}``."""
+        cfg = self.cfg
+        tx = tx if tx is not None else self._default_tx(lr)
+
+        def loss_of(tree, tokens, labels, axes):
+            return cls_loss_local(tree["backbone"], tree["head"], tokens,
+                                  labels, cfg, **axes)
+
+        return self._build_step(tx, loss_of, self.finetune_specs(),
+                                (P(DP, SP), P(DP)))
 
     def place(self, tree, specs=None):
         """Device-put a pytree onto the mesh per param_specs."""
@@ -403,7 +510,8 @@ class TransformerLM:
         specs = specs if specs is not None else param_specs(self.cfg)
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-            tree, specs)
+            tree, specs, is_leaf=lambda x: isinstance(x, P))
 
-    def init_momentum(self, params):
-        return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+    def init_opt_momentum(self, params, lr: float = 1e-3):
+        """Convenience: opt state for the default SGD+momentum transform."""
+        return self.init_opt(params, self._default_tx(lr))

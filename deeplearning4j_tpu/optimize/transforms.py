@@ -29,6 +29,15 @@ class GradientTransform(NamedTuple):
     init: Callable[[Any], Any]           # params -> state
     update: Callable[[Any, Any, Any, Any], tuple[Any, Any]]
     # (grads, state, params, iteration) -> (new_grads, new_state)
+    state_spec: Callable[[Any], Any] | None = None
+    # param_specs -> state_specs (same structure as init's output); None
+    # means the state is EMPTY (stateless transform).  Stateful custom
+    # transforms used with sharded trainers must provide this so optimizer
+    # state is placed with the same PartitionSpecs as the params it mirrors.
+
+
+def _empty_spec(param_specs):
+    return ()
 
 
 def chain(*transforms: GradientTransform) -> GradientTransform:
@@ -42,7 +51,11 @@ def chain(*transforms: GradientTransform) -> GradientTransform:
             new_state.append(s2)
         return grads, tuple(new_state)
 
-    return GradientTransform(init, update)
+    def state_spec(param_specs):
+        return tuple((t.state_spec or _empty_spec)(param_specs)
+                     for t in transforms)
+
+    return GradientTransform(init, update, state_spec)
 
 
 def identity() -> GradientTransform:
@@ -54,23 +67,134 @@ def scale(factor: float) -> GradientTransform:
                              lambda g, s, p=None, i=0: (tree_map(lambda x: factor * x, g), s))
 
 
+def _f32_zeros(params):
+    """Optimizer state is ALWAYS f32 (even for bf16 params): accumulators
+    round away small contributions in low precision."""
+    return tree_map(lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+
+
 def adagrad(lr: float, eps: float = 1e-6) -> GradientTransform:
     """Per-parameter adaptive LR (reference: nd4j ``AdaGrad``,
     ``BaseOptimizer.java:29,68-118``): g * lr / sqrt(sum g^2 + eps)."""
 
-    def init(params):
-        return tree_map(jnp.zeros_like, params)
+    init = _f32_zeros
 
     def update(grads, hist, params=None, iteration=0):
-        hist = tree_map(lambda h, g: h + g * g, hist, grads)
+        hist = tree_map(lambda h, g: h + g.astype(jnp.float32) ** 2, hist, grads)
         out = tree_map(lambda g, h: lr * g * jax.lax.rsqrt(h + eps), grads, hist)
         return out, hist
 
-    return GradientTransform(init, update)
+    return GradientTransform(init, update, lambda ps: ps)
 
 
 def sgd_lr(lr: float) -> GradientTransform:
     return scale(lr)
+
+
+# --------------------------------------------------------------------- schedules
+#
+# A schedule is a jit-safe callable ``step -> lr`` (step may be a traced
+# int).  ``scale_by_schedule`` accepts either a float or a schedule, so
+# ``adam(warmup_cosine(...))`` and ``adam(1e-3)`` both work.
+
+def constant_schedule(lr: float) -> Callable[[Any], Any]:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def warmup_linear(peak: float, warmup_steps: int, total_steps: int,
+                  end: float = 0.0) -> Callable[[Any], Any]:
+    """Linear warmup 0→peak then linear decay peak→end (the BERT fine-tune
+    schedule)."""
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak * step / max(warmup_steps, 1)
+        frac = (step - warmup_steps) / max(total_steps - warmup_steps, 1)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        decay = peak + (end - peak) * frac
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return sched
+
+
+def warmup_cosine(peak: float, warmup_steps: int, total_steps: int,
+                  end: float = 0.0) -> Callable[[Any], Any]:
+    """Linear warmup then cosine decay to ``end``."""
+
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak * step / max(warmup_steps, 1)
+        frac = (step - warmup_steps) / max(total_steps - warmup_steps, 1)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        decay = end + 0.5 * (peak - end) * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return sched
+
+
+def scale_by_schedule(lr) -> GradientTransform:
+    """Multiply updates by ``lr`` (float) or ``lr(iteration)`` (schedule)."""
+
+    def update(grads, s, params=None, iteration=0):
+        factor = lr(iteration) if callable(lr) else lr
+        return tree_map(lambda g: g * factor, grads), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+# --------------------------------------------------------------------- adam family
+
+def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8) -> GradientTransform:
+    """Adam moment rescaling (Kingma & Ba) with bias correction driven by
+    the ``iteration`` argument.  State = (mu, nu), f32 device arrays mirroring
+    the param tree — the TPU-native replacement for the reference's mutable
+    nd4j learner state (``BaseOptimizer.java:68-118`` seam)."""
+
+    def init(params):
+        return (_f32_zeros(params), _f32_zeros(params))
+
+    def update(grads, state, params=None, iteration=0):
+        mu, nu = state
+        g32 = tree_map(lambda g: g.astype(jnp.float32), grads)
+        mu = tree_map(lambda m, g: b1 * m + (1 - b1) * g, mu, g32)
+        nu = tree_map(lambda v, g: b2 * v + (1 - b2) * g * g, nu, g32)
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        c1 = 1.0 - jnp.power(b1, t)
+        c2 = 1.0 - jnp.power(b2, t)
+        out = tree_map(
+            lambda m, v: (m / c1) / (jnp.sqrt(v / c2) + eps), mu, nu)
+        return out, (mu, nu)
+
+    return GradientTransform(init, update, lambda ps: (ps, ps))
+
+
+def add_decayed_weights(wd: float) -> GradientTransform:
+    """Decoupled weight decay (AdamW): updates += wd * w on weight matrices
+    (ndim >= 2) only — biases/layernorms stay undecayed."""
+
+    def update(grads, s, params=None, iteration=0):
+        if params is None or wd == 0.0:
+            return grads, s
+        return tree_map(
+            lambda g, w: g + wd * w.astype(g.dtype) if w.ndim >= 2 else g,
+            grads, params), s
+
+    return GradientTransform(lambda p: (), update)
+
+
+def adam(lr=1e-3, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8) -> GradientTransform:
+    """Adam: moment rescaling then LR (float or schedule)."""
+    return chain(scale_by_adam(b1, b2, eps), scale_by_schedule(lr))
+
+
+def adamw(lr=1e-3, weight_decay: float = 0.01, b1: float = 0.9,
+          b2: float = 0.999, eps: float = 1e-8) -> GradientTransform:
+    """AdamW: decoupled weight decay added after moment rescaling, both
+    scaled by the schedule (Loshchilov & Hutter)."""
+    return chain(scale_by_adam(b1, b2, eps), add_decayed_weights(weight_decay),
+                 scale_by_schedule(lr))
 
 
 def momentum(base: float, schedule: dict[int, float] | None = None) -> GradientTransform:
@@ -87,15 +211,14 @@ def momentum(base: float, schedule: dict[int, float] | None = None) -> GradientT
         idx = jnp.sum(its <= iteration) - 1
         return jnp.where(idx >= 0, vals[jnp.maximum(idx, 0)], base)
 
-    def init(params):
-        return tree_map(jnp.zeros_like, params)
+    init = _f32_zeros
 
     def update(grads, vel, params=None, iteration=0):
         m = momentum_at(iteration)
-        vel = tree_map(lambda v, g: m * v + g, vel, grads)
+        vel = tree_map(lambda v, g: m * v + g.astype(jnp.float32), vel, grads)
         return vel, vel
 
-    return GradientTransform(init, update)
+    return GradientTransform(init, update, lambda ps: ps)
 
 
 def weight_decay(l2: float) -> GradientTransform:
@@ -155,16 +278,19 @@ def divide_by_batch(batch_size_fn: Callable[[], float] | float) -> GradientTrans
 
 def from_conf(conf: NeuralNetConfiguration) -> GradientTransform:
     """Assemble the reference's exact post-processing chain from a conf
-    (order per ``BaseOptimizer.java:68-118``)."""
+    (order per ``BaseOptimizer.java:68-118``): AdaGrad (or plain LR) first,
+    then momentum, then L2 — the reference subtracts ``l2*params`` AFTER the
+    adaptive-LR scaling, so the decay term is NOT rescaled by the per-param
+    learning rate — then the unit-norm clip."""
     parts: list[GradientTransform] = []
-    if conf.use_regularization and conf.l2 > 0:
-        parts.append(weight_decay(conf.l2))
     if conf.use_adagrad:
         parts.append(adagrad(conf.lr))
     else:
         parts.append(sgd_lr(conf.lr))
     if conf.momentum > 0 or conf.momentum_schedule:
         parts.append(momentum(conf.momentum, conf.momentum_schedule))
+    if conf.use_regularization and conf.l2 > 0:
+        parts.append(weight_decay(conf.l2))
     if conf.constrain_gradient_to_unit_norm:
         parts.append(clip_unit_norm())
     return chain(*parts) if parts else identity()

@@ -136,3 +136,77 @@ def test_listener_and_termination():
     res = solver.optimize({"x": jnp.zeros(1)})
     assert res.converged and res.iterations < 500
     assert len(listener.scores) == res.iterations
+
+
+def test_adam_bias_correction_first_step():
+    """First Adam update ≈ sign(g) * lr regardless of gradient scale (the
+    bias-corrected m/sqrt(v) is ±1 for a constant gradient)."""
+    t = tfm.adam(lr=0.01)
+    p = {"x": jnp.zeros(3)}
+    g = {"x": jnp.array([10.0, -0.001, 2.0])}
+    s = t.init(p)
+    u, s = t.update(g, s, p, 0)
+    np.testing.assert_allclose(np.asarray(u["x"]),
+                               0.01 * np.sign([10.0, -0.001, 2.0]), rtol=1e-3)
+
+
+def test_adam_minimizes_quadratic():
+    t = tfm.adam(lr=0.1)
+    p = {"x": jnp.array([5.0, -3.0])}
+    s = t.init(p)
+    for i in range(300):
+        g = {"x": p["x"] - jnp.array([1.0, 2.0])}
+        u, s = t.update(g, s, p, i)
+        p = tfm.apply_updates(p, u)
+    np.testing.assert_allclose(np.asarray(p["x"]), [1.0, 2.0], atol=1e-2)
+
+
+def test_adamw_decays_matrices_not_biases():
+    """Decoupled decay hits ndim>=2 leaves only."""
+    t = tfm.adamw(lr=0.1, weight_decay=0.5)
+    p = {"W": jnp.ones((2, 2)), "b": jnp.ones((2,))}
+    g = {"W": jnp.zeros((2, 2)), "b": jnp.zeros((2,))}
+    s = t.init(p)
+    u, s = t.update(g, s, p, 0)
+    # zero gradient: W update = lr * wd * W, b update = 0
+    np.testing.assert_allclose(np.asarray(u["W"]), 0.1 * 0.5 * np.ones((2, 2)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(u["b"]), 0.0, atol=1e-9)
+
+
+def test_warmup_cosine_schedule_shape():
+    sched = tfm.warmup_cosine(1.0, 10, 110, end=0.1)
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(5)), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(110)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(60)), 0.55, rtol=1e-6)  # midpoint
+
+
+def test_warmup_linear_schedule_shape():
+    sched = tfm.warmup_linear(1.0, 10, 110, end=0.0)
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(60)), 0.5, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(110)), 0.0, atol=1e-7)
+
+
+def test_from_conf_l2_after_adaptive_lr():
+    """ADVICE fix: the reference subtracts l2*w AFTER adagrad scaling, so
+    with zero gradient the update must be exactly l2*w (not rescaled)."""
+    conf = NeuralNetConfiguration(lr=0.5, use_adagrad=True, momentum=0.0,
+                                  use_regularization=True, l2=0.1)
+    t = tfm.from_conf(conf)
+    p = {"W": jnp.full((2, 2), 3.0)}
+    g = {"W": jnp.zeros((2, 2))}
+    s = t.init(p)
+    u, _ = t.update(g, s, p, 0)
+    np.testing.assert_allclose(np.asarray(u["W"]), 0.1 * 3.0, rtol=1e-5)
+
+
+def test_state_spec_mirrors_params():
+    from jax.sharding import PartitionSpec as P
+    tx = tfm.adamw(lr=0.1)
+    ps = {"W": P("tp", None), "b": P()}
+    spec = tx.state_spec(ps)
+    # chain(scale_by_adam, add_decayed_weights, scale_by_schedule)
+    assert spec[0] == (ps, ps)
+    assert spec[1] == () and spec[2] == ()
