@@ -102,6 +102,27 @@ class Glove:
         self.syn0 = None
         self.losses: list[float] = []
 
+    # ------------------------------------------------------------------ step seams
+    # (overridden by ShardedGlove to run over mesh-sharded tables)
+    def _init_tables(self, n: int, d: int, rng) -> None:
+        self._tables = [
+            jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d),   # w
+            jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d),   # wc
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),  # b, bc
+            jnp.zeros((n, d), jnp.float32), jnp.zeros((n, d), jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),
+        ]
+
+    def _apply_step(self, rows, cols, logx, fx) -> float:
+        *self._tables, loss = _glove_step(
+            *self._tables, rows, cols, logx, fx,
+            jnp.float32(self.learning_rate))
+        return float(loss)
+
+    def _final_embeddings(self, n: int):
+        w, wc = self._tables[0], self._tables[1]
+        return (w + wc)[:n]  # standard GloVe: sum of both embeddings
+
     def fit(self) -> "Glove":
         self.vocab = build_vocab(self.sentences, self.tokenizer_factory,
                                  self.min_word_frequency)
@@ -110,14 +131,7 @@ class Glove:
         rows, cols, vals = co.arrays()
         n, d = len(self.vocab), self.layer_size
         rng = np.random.default_rng(self.seed)
-        w = jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d)
-        wc = jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d)
-        b = jnp.zeros((n,), jnp.float32)
-        bc = jnp.zeros((n,), jnp.float32)
-        hw = jnp.zeros((n, d), jnp.float32)
-        hwc = jnp.zeros((n, d), jnp.float32)
-        hb = jnp.zeros((n,), jnp.float32)
-        hbc = jnp.zeros((n,), jnp.float32)
+        self._init_tables(n, d, rng)
         logx = np.log(np.maximum(vals, 1e-12)).astype(np.float32)
         fx = np.minimum(1.0, (vals / self.x_max) ** self.alpha).astype(np.float32)
         m = rows.shape[0]
@@ -127,15 +141,12 @@ class Glove:
             nb = 0
             for off in range(0, m, self.batch_size):
                 sl = perm[off:off + self.batch_size]
-                w, wc, b, bc, hw, hwc, hb, hbc, loss = _glove_step(
-                    w, wc, b, bc, hw, hwc, hb, hbc,
+                epoch_loss += self._apply_step(
                     jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
-                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]),
-                    jnp.float32(self.learning_rate))
-                epoch_loss += float(loss)
+                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]))
                 nb += 1
             self.losses.append(epoch_loss / max(1, nb))
-        self.syn0 = w + wc  # standard GloVe: sum of both embeddings
+        self.syn0 = self._final_embeddings(n)
         return self
 
     # query API mirrors Word2Vec

@@ -72,8 +72,11 @@ def load_google_binary(path: str | Path) -> tuple[list[str], np.ndarray]:
 
 def save_word2vec(model, path: str | Path, binary: bool = False) -> None:
     words = model.vocab.words()
-    vectors = np.asarray(model.syn0)
-    (save_google_binary if binary else save_txt)(words, vectors, path)
+    # embeddings (not syn0): trims shard padding on ShardedWord2Vec so the
+    # header row count matches the records written
+    vectors = (model.embeddings if hasattr(model, "embeddings")
+               else np.asarray(model.syn0))
+    (save_google_binary if binary else save_txt)(words, np.asarray(vectors), path)
 
 
 def load_into_word2vec(path: str | Path, binary: bool = False):

@@ -79,6 +79,37 @@ def _sample_negatives(key, probs_log, shape):
     return jax.random.categorical(key, probs_log, shape=shape)
 
 
+# --------------------------------------------------------------------------- pair generation
+
+def skipgram_pairs(sentences_idx: Sequence[np.ndarray], window: int,
+                   rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray]:
+    """All (center, context) skip-gram pairs with random window shrink
+    (the reference draws a random gap per position, Word2Vec.java:312).
+    Native C++ fast path when the host library is built."""
+    try:
+        from ..native import runtime as native_rt
+        native = native_rt.skipgram_pairs(
+            list(sentences_idx), window, int(rng.integers(1, 2**63)))
+        if native is not None:
+            return native
+    except ImportError:
+        pass
+    centers, contexts = [], []
+    for idx in sentences_idx:
+        n = idx.size
+        b = rng.integers(0, window, n)  # random reduced window
+        for pos in range(n):
+            w = window - b[pos]
+            lo, hi = max(0, pos - w), min(n, pos + w + 1)
+            for j in range(lo, hi):
+                if j != pos:
+                    centers.append(idx[pos])
+                    contexts.append(idx[j])
+    if not centers:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+
+
 # --------------------------------------------------------------------------- model
 
 class Word2Vec:
@@ -157,31 +188,23 @@ class Word2Vec:
 
     def _pairs(self, sentences_idx: Sequence[np.ndarray],
                rng: np.random.Generator) -> tuple[np.ndarray, np.ndarray]:
-        """All (center, context) skip-gram pairs with random window shrink
-        (the reference draws a random gap per position, Word2Vec.java:312).
-        Native C++ fast path when the host library is built."""
-        try:
-            from ..native import runtime as native_rt
-            native = native_rt.skipgram_pairs(
-                list(sentences_idx), self.window, int(rng.integers(1, 2**63)))
-            if native is not None:
-                return native
-        except ImportError:
-            pass
-        centers, contexts = [], []
-        for idx in sentences_idx:
-            n = idx.size
-            b = rng.integers(0, self.window, n)  # random reduced window
-            for pos in range(n):
-                w = self.window - b[pos]
-                lo, hi = max(0, pos - w), min(n, pos + w + 1)
-                for j in range(lo, hi):
-                    if j != pos:
-                        centers.append(idx[pos])
-                        contexts.append(idx[j])
-        if not centers:
-            return np.zeros(0, np.int32), np.zeros(0, np.int32)
-        return np.asarray(centers, np.int32), np.asarray(contexts, np.int32)
+        return skipgram_pairs(sentences_idx, self.window, rng)
+
+    # ------------------------------------------------------------------ step seams
+    # (overridden by ShardedWord2Vec to run the same schedule over mesh-
+    # sharded tables — the TPU-native Word2VecWork row-shipping equivalent)
+    def _apply_hs(self, cb, pts, cds, msk, alpha):
+        self.syn0, self.syn1 = _hs_step(self.syn0, self.syn1, cb, pts, cds,
+                                        msk, alpha)
+
+    def _apply_ns(self, cb, targets, labels, alpha):
+        self.syn0, self.syn1neg = _ns_step(self.syn0, self.syn1neg, cb,
+                                           targets, labels, alpha)
+
+    @property
+    def embeddings(self) -> np.ndarray:
+        """(n_vocab, D) host array — trims any shard padding."""
+        return np.asarray(self.syn0)[:len(self.vocab)]
 
     # ------------------------------------------------------------------ fit
     def fit(self) -> "Word2Vec":
@@ -217,10 +240,8 @@ class Word2Vec:
                 alpha = max(self.min_learning_rate,
                             self.learning_rate * (1.0 - pairs_seen / pairs_total))
                 if self.use_hs:
-                    self.syn0, self.syn1 = _hs_step(
-                        self.syn0, self.syn1, cb,
-                        points[xb], codes[xb], mask_table[xb],
-                        jnp.float32(alpha))
+                    self._apply_hs(cb, points[xb], codes[xb], mask_table[xb],
+                                   jnp.float32(alpha))
                 if self.negative > 0:
                     key, sub = jax.random.split(key)
                     negs = _sample_negatives(
@@ -230,9 +251,7 @@ class Word2Vec:
                         [jnp.ones((cb.shape[0], 1), jnp.float32),
                          jnp.zeros((cb.shape[0], self.negative), jnp.float32)],
                         axis=1)
-                    self.syn0, self.syn1neg = _ns_step(
-                        self.syn0, self.syn1neg, cb, targets, labels,
-                        jnp.float32(alpha))
+                    self._apply_ns(cb, targets, labels, jnp.float32(alpha))
                 pairs_seen += cb.shape[0]
         return self
 
@@ -257,7 +276,7 @@ class Word2Vec:
                 return []
         else:
             vec, exclude = np.asarray(word_or_vec), set()
-        return nearest(np.asarray(self.syn0), vec, self.vocab.word_at, n, exclude)
+        return nearest(self.embeddings, vec, self.vocab.word_at, n, exclude)
 
     def accuracy(self, analogies: Sequence[tuple[str, str, str, str]]) -> float:
         """a:b :: c:d analogy accuracy (reference ``accuracy`` API)."""

@@ -1,0 +1,135 @@
+"""Distributed embedding training tests (VERDICT missing #1).
+
+Parity discipline: the mesh-sharded models share the single-device models'
+schedule and RNG, so row-sharding the tables over `ep` must reproduce the
+single-device result to float tolerance.  The scaleout row-shipping path is
+checked for convergence semantics (same nearest-neighbor structure)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.text.glove import Glove
+from deeplearning4j_tpu.text.sharded_embedding import (
+    ShardedGlove,
+    ShardedWord2Vec,
+    pad_rows,
+)
+from deeplearning4j_tpu.text.word2vec import Word2Vec
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the rug",
+    "a cat and a dog played",
+    "the king ruled the land",
+    "the queen ruled the kingdom",
+    "a king and a queen reigned",
+    "cats chase mice in the barn",
+    "dogs chase cats in the yard",
+] * 6
+
+
+def ep_mesh(n=8):
+    return make_mesh(MeshSpec(dp=1, tp=1, pp=1, sp=1, ep=n))
+
+
+def test_pad_rows():
+    assert pad_rows(10, 8) == 16
+    assert pad_rows(16, 8) == 16
+    assert pad_rows(1, 8) == 8
+    assert pad_rows(0, 4) == 4
+
+
+@pytest.mark.parametrize("negative,hs", [(0, True), (5, True), (5, False)])
+def test_sharded_word2vec_matches_single_device(negative, hs):
+    """Row-sharded tables + psum row shipping == single-device training,
+    for HS, HS+NS, and NS-only modes."""
+    kw = dict(layer_size=16, window=3, iterations=2, seed=11,
+              negative=negative, use_hierarchic_softmax=hs, batch_size=256)
+    solo = Word2Vec(CORPUS, **kw).fit()
+    shard = ShardedWord2Vec(CORPUS, mesh=ep_mesh(), **kw).fit()
+
+    np.testing.assert_allclose(shard.embeddings, solo.embeddings,
+                               rtol=1e-4, atol=1e-5)
+    n1 = np.asarray(solo.syn1).shape[0]
+    np.testing.assert_allclose(np.asarray(shard.syn1)[:n1],
+                               np.asarray(solo.syn1), rtol=1e-4, atol=1e-5)
+    # query API agrees
+    assert shard.words_nearest("cat", 3) == solo.words_nearest("cat", 3)
+
+
+def test_sharded_word2vec_semantic_structure():
+    w2v = ShardedWord2Vec(CORPUS, mesh=ep_mesh(), layer_size=24, window=3,
+                          iterations=12, seed=3).fit()
+    assert w2v.similarity("king", "queen") > w2v.similarity("king", "mat")
+
+
+def test_sharded_glove_matches_single_device():
+    kw = dict(layer_size=12, window=5, iterations=4, seed=5, batch_size=512)
+    solo = Glove(CORPUS, **kw).fit()
+    shard = ShardedGlove(CORPUS, mesh=ep_mesh(), **kw).fit()
+    np.testing.assert_allclose(np.asarray(shard.syn0), np.asarray(solo.syn0),
+                               rtol=1e-4, atol=1e-5)
+    assert shard.words_nearest("cat", 3) == solo.words_nearest("cat", 3)
+
+
+# --------------------------------------------------------------------------- scaleout
+
+def _tokenized(corpus, w2v):
+    fac = w2v.tokenizer_factory
+    out = []
+    for s in corpus:
+        toks = fac.create(s).get_tokens()
+        idx = np.array([i for i in (w2v.vocab.index_of(t) for t in toks)
+                        if i >= 0], np.int32)
+        if idx.size >= 2:
+            out.append(idx)
+    return out
+
+
+@pytest.mark.parametrize("negative", [0, 3])
+def test_scaleout_word2vec_performer(negative):
+    """Row-shipping distributed Word2Vec over the scaleout SPI
+    (Word2VecPerformer.java:72-137 semantics): multi-worker training
+    converges to the same semantic structure as local training."""
+    from deeplearning4j_tpu.parallel.scaleout import (
+        DistributedRunner, HogWildWorkRouter, StateTracker)
+    from deeplearning4j_tpu.text.scaleout_embeddings import (
+        EmbeddingTables, RowDeltaAggregator, Word2VecJobIterator,
+        Word2VecPerformer, WORDS_KEY)
+
+    base = Word2Vec(CORPUS, layer_size=24, window=3, negative=negative,
+                    use_hierarchic_softmax=(negative == 0), seed=3)
+    base.build_vocab()
+    base.reset_weights()
+    tables = EmbeddingTables.from_model(base)
+    sents = _tokenized(CORPUS, base)
+
+    tracker = StateTracker()
+    it = Word2VecJobIterator(
+        sents * 12, tables, window=3, chunk=6, negative=negative,
+        alpha=0.05, iterations=1, tracker=tracker)
+
+    codes, points, lengths = base.huffman.code_arrays()
+
+    def performer_factory(tr):
+        hs = negative == 0
+        return Word2VecPerformer(
+            tr, window=3, negative=negative,
+            codes=codes.astype(np.float32) if hs else None,
+            points=points if hs else None,
+            lengths=lengths if hs else None)
+
+    runner = DistributedRunner(
+        it, performer_factory, n_workers=3,
+        router_cls=HogWildWorkRouter, tracker=tracker)
+    runner.router.aggregator_factory = lambda: RowDeltaAggregator(tables)
+    runner.run(max_wall_s=120.0)
+
+    assert tracker.count(WORDS_KEY) > 0
+    # read trained vectors through the model facade
+    base.syn0 = jnp.asarray(tables.syn0)
+    assert base.similarity("king", "queen") > base.similarity("king", "mat")
+    assert base.similarity("cat", "dog") > base.similarity("cat", "kingdom")
