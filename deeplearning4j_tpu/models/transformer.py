@@ -503,6 +503,48 @@ class TransformerLM:
         return self._build_step(tx, loss_of, self.finetune_specs(),
                                 (P(DP, SP), P(DP)))
 
+    def fit(self, params, opt, batches, *, tx=None, lr: float = 1e-3,
+            epochs: int = 1, finetune: bool = False,
+            checkpoint_manager=None, checkpoint_every: int = 0,
+            resume: bool = True):
+        """Convenience training loop with auto-checkpoint/resume.
+
+        ``batches``: list of (tokens, targets|labels) pairs.  Runs to
+        ``epochs * len(batches)`` total steps counted by the optimizer's
+        step counter, so a restored state continues where it left off.
+        Checkpoints carry params + full transform state + data cursor
+        (exceeds the reference's bare-params ``ModelSavingActor.java:75-79``).
+        """
+        tx = tx if tx is not None else self._default_tx(lr)
+        step_fn = (self.build_finetune_step(tx) if finetune
+                   else self.build_train_step(tx))
+        specs = self.finetune_specs() if finetune else param_specs(self.cfg)
+
+        if (checkpoint_manager is not None and resume
+                and checkpoint_manager.latest_step() is not None):
+            r = checkpoint_manager.restore(params, tstate_template=opt)
+            params, opt = r["params"], r["tstate"]
+            if self.mesh is not None:
+                params = self.place(params, specs)
+                opt = self.place(opt, self.opt_specs(tx, specs))
+
+        def save():
+            checkpoint_manager.save(int(opt[0]), params, tstate=opt,
+                                    data_cursor=int(opt[0]))
+
+        losses = []
+        total = epochs * len(batches)
+        while int(opt[0]) < total:
+            a, b = batches[int(opt[0]) % len(batches)]
+            params, opt, loss = step_fn(params, opt, a, b)
+            losses.append(float(loss))
+            if (checkpoint_manager is not None and checkpoint_every > 0
+                    and int(opt[0]) % checkpoint_every == 0):
+                save()
+        if checkpoint_manager is not None and losses:
+            save()
+        return params, opt, losses
+
     def place(self, tree, specs=None):
         """Device-put a pytree onto the mesh per param_specs."""
         if self.mesh is None:

@@ -176,14 +176,55 @@ class DataParallelTrainer:
         return TrainState(params, tstate, state.step + 1, state.key), mean_loss
 
     def fit(self, state: TrainState, data: Iterable[DataSet] | DataSet,
-            epochs: int = 1) -> tuple[TrainState, list[float]]:
+            epochs: int = 1, *, checkpoint_manager=None,
+            checkpoint_every: int = 0, resume: bool = True,
+            ) -> tuple[TrainState, list[float]]:
+        """Run to ``epochs * n_batches`` total steps, counting from
+        ``state.step`` — so a state restored from a checkpoint continues
+        where it left off (the elastic-recovery resume path; the reference
+        only ever re-loaded bare params, ``ModelSavingActor.java:75-79``).
+
+        With ``checkpoint_manager`` set, auto-saves params + transform state
+        + RNG key + data cursor every ``checkpoint_every`` steps (and at the
+        end); with ``resume`` (default) restores the latest checkpoint
+        before training."""
+        batches = [data] if isinstance(data, DataSet) else list(data)
+        if checkpoint_manager is not None and resume \
+                and checkpoint_manager.latest_step() is not None:
+            state = self.restore(state, checkpoint_manager)
         losses = []
-        for _ in range(epochs):
-            batches = [data] if isinstance(data, DataSet) else data
-            for b in batches:
-                state, loss = self.step(state, b.features, b.labels)
-                losses.append(loss)
+        total = epochs * len(batches)
+        while state.step < total:
+            b = batches[state.step % len(batches)]
+            state, loss = self.step(state, b.features, b.labels)
+            losses.append(loss)
+            if (checkpoint_manager is not None and checkpoint_every > 0
+                    and state.step % checkpoint_every == 0):
+                self.checkpoint(state, checkpoint_manager)
+        if checkpoint_manager is not None and losses:
+            self.checkpoint(state, checkpoint_manager)
         return state, losses
+
+    # ------------------------------------------------------------------ ckpt
+    def checkpoint(self, state: TrainState, manager) -> None:
+        manager.save(state.step, state.params, tstate=state.tstate,
+                     key=state.key, data_cursor=state.step)
+
+    def restore(self, template: TrainState, manager) -> TrainState:
+        """Restore the latest checkpoint into a state shaped like
+        ``template`` (fresh ``init_state`` output), re-placed on the mesh."""
+        r = manager.restore(template.params, tstate_template=template.tstate)
+        params = jax.tree_util.tree_map(
+            lambda t, a: jax.device_put(jnp.asarray(a), t.sharding),
+            template.params, r["params"])
+        tstate = template.tstate
+        if r["tstate"] is not None:
+            tstate = jax.tree_util.tree_map(
+                lambda t, a: (jax.device_put(jnp.asarray(a), t.sharding)
+                              if isinstance(t, jnp.ndarray) else a),
+                template.tstate, r["tstate"])
+        key = r["key"] if r["key"] is not None else template.key
+        return TrainState(params=params, tstate=tstate, step=r["step"], key=key)
 
     def final_params(self, state: TrainState):
         """Collapse to a single param set (average replicas for hogwild)."""
