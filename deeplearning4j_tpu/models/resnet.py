@@ -35,6 +35,37 @@ class ResNetConfig:
     def resnet50(cls, num_classes=1000, **kw):
         return cls(num_classes=num_classes, stage_sizes=(3, 4, 6, 3), **kw)
 
+    def flops_per_image(self, image_size: int = 224) -> float:
+        """Analytic training FLOPs per image (2*MACs forward, ×3 for
+        fwd+bwd), counting convs + the classifier matmul.  Used for MFU
+        accounting in bench.py (same 2*MACs convention the transformer leg
+        validates against XLA ``cost_analysis()`` there)."""
+        def conv_flops(hw, k, cin, cout, stride):
+            out_hw = hw // stride
+            return 2.0 * out_hw * out_hw * k * k * cin * cout, out_hw
+
+        total, hw = 0.0, image_size
+        f, hw = conv_flops(hw, 7, 3, self.width, 2)          # stem
+        total += f
+        hw //= 2                                             # 3x3/2 max pool
+        c_in = self.width
+        for s, blocks in enumerate(self.stage_sizes):
+            c_mid = self.width * (2 ** s)
+            c_out = c_mid * 4
+            for b in range(blocks):
+                stride = 2 if (s > 0 and b == 0) else 1
+                f1, _ = conv_flops(hw, 1, c_in, c_mid, 1)
+                f2, hw2 = conv_flops(hw, 3, c_mid, c_mid, stride)
+                f3, _ = conv_flops(hw2, 1, c_mid, c_out, 1)
+                total += f1 + f2 + f3
+                if c_in != c_out or stride != 1:
+                    fp, _ = conv_flops(hw, 1, c_in, c_out, stride)
+                    total += fp
+                hw = hw2
+                c_in = c_out
+        total += 2.0 * c_in * self.num_classes               # head matmul
+        return 3.0 * total                                   # fwd + bwd
+
 
 def _conv_init(key, shape, pd):
     fan_in = shape[0] * shape[1] * shape[2]
