@@ -276,17 +276,21 @@ print(json.dumps({"median_step_s": times[len(times)//2]}))
 
 
 def _scaling_leg(timeout_s: float = 420.0):
-    """Sharding-overhead sweep on the virtual 8-device CPU mesh (subprocess:
+    """Data-parallel sweep on the virtual 8-device CPU mesh (subprocess:
     the TPU-registered parent can't switch platforms).
 
-    All virtual devices share one CPU, so classic weak-scaling numbers
-    would only measure the host's core count.  What IS measurable without
-    N real chips is the cost the data-parallel machinery adds: for each
-    dp in {1,2,4,8}, run total batch 4*dp (a) on a single device and
-    (b) sharded over dp mesh devices with the gradient-pmean step.
-    efficiency = t_single / t_mesh at equal total work (1.0 = the
-    collectives/partitioning added nothing).  BASELINE.md '8 -> 64 chips'
-    path; reference analog IterativeReduceWorkRouter.java:16,30."""
+    All virtual devices share one host CPU, so NO number from this sweep
+    is a chip-scaling efficiency: the mesh run and the single-device run
+    both use the same silicon, and they use its cores differently (XLA
+    intra-op threading vs per-device parallelism).  What the sweep does
+    establish: the dp=k gradient-pmean step runs, at equal total work,
+    within a small factor of the unsharded step — i.e. the data-parallel
+    machinery itself is not a bottleneck.  ``relative_throughput`` is
+    t_single/t_mesh at equal total work; values != 1 reflect host thread
+    scheduling, not collective cost.  Real 8->64-chip efficiency must be
+    measured on real chips — the same child program, dp over real devices,
+    is the path (BASELINE.md '8 -> 64 chips'; reference analog
+    IterativeReduceWorkRouter.java:16,30)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -311,12 +315,15 @@ def _scaling_leg(timeout_s: float = 420.0):
     except Exception as e:        # child died / bad stdout — never kill bench
         return {"error": str(e)[:300]}
     return {
-        "mode": "dp_overhead_vs_single_device_virtual_cpu_mesh",
+        "mode": "dp_machinery_check_virtual_cpu_mesh",
+        "note": ("shared-host virtual devices: relative_throughput reflects "
+                 "host thread scheduling, NOT chip-scaling efficiency; see "
+                 "_scaling_leg docstring"),
         "total_batch": {str(dp): 4 * dp for dp in single},
         "single_step_s": {str(dp): round(t, 5) for dp, t in single.items()},
         "mesh_step_s": {str(dp): round(t, 5) for dp, t in mesh.items()},
-        "efficiency": {str(dp): round(single[dp] / mesh[dp], 4)
-                       for dp in single},
+        "relative_throughput": {str(dp): round(single[dp] / mesh[dp], 4)
+                                for dp in single},
     }
 
 
@@ -331,7 +338,18 @@ def main():
 
     problems = []
 
-    bert = _bert_leg(dev, on_tpu)
+    try:
+        bert = _bert_leg(dev, on_tpu)
+    except Exception as e:
+        # Headline leg failed (OOM, tunnel death mid-run, compile error):
+        # still honor the one-JSON-line contract, publish no claim, fail.
+        out = {"metric": "bert_base_train_tokens_per_sec_ERROR", "value": 0.0,
+               "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+               "extra": {"device": str(dev), "error": repr(e)[:400],
+                         "wall_s": round(time.time() - t_start, 1)}}
+        print(json.dumps(out))
+        print(f"BENCH ERROR: {e!r}", file=sys.stderr)
+        sys.exit(1)
     bert_problems, bert_mfu = _validity_checks(
         "bert", bert["iter_times"], bert["flops_per_iter"], peak)
     problems += bert_problems

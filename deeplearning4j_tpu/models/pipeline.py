@@ -1,0 +1,240 @@
+"""Pipeline parallelism over the ``pp`` mesh axis — GPipe-style micro-batch
+fill/drain, TPU-first.
+
+No reference analog (the v0 reference tops out at parameter-averaging data
+parallelism, ``IterativeReduceWorkRouter.java:16``); the spec is the
+BASELINE.json north star (multi-axis sharding on a pod).  The design is the
+idiomatic JAX/XLA one, NOT a port of torch-style stage processes:
+
+- The transformer blocks are **stacked on a leading layer axis** and that
+  axis is sharded over ``pp``: each pp rank holds ``n_layers / pp``
+  contiguous blocks (a *stage*) as one pytree of ``(L_loc, ...)`` leaves.
+- ONE SPMD program runs on every rank under ``shard_map``.  A ``lax.scan``
+  over ``M + S - 1`` ticks implements fill/drain: at each tick a rank
+  applies its stage to its current activation and hands the result to the
+  next rank via ``lax.ppermute``.  Rank 0 feeds micro-batch ``t`` in; the
+  last rank collects finished micro-batches from tick ``S-1`` on.
+- **Backward needs no schedule of its own**: the VJP of ``ppermute`` is the
+  reverse rotation, so differentiating the scan yields the drain-ordered
+  backward pipeline automatically.
+- Embedding/final-LN/head are replicated over ``pp`` but *used* only on the
+  first/last rank; their local gradients are partial contributions (zero on
+  unused ranks), so the pp gradient sync is ``psum`` — unlike dp/sp where
+  replicas hold full per-shard gradients and the sync is ``pmean``.
+
+Composes with the existing axes: dp (batch shard + grad pmean), sp (ring
+attention inside each block), tp (Megatron psum boundaries inside each
+block) — all in the same mesh, same shard_map.
+
+Bubble fraction is ``(S-1)/(M+S-1)``; pick ``n_micro >= 2*S`` (GPipe's
+guidance is ~4x) to keep it small.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DP, PP, SP
+from .transformer import (
+    TransformerConfig,
+    TransformerLM,
+    _block,
+    _layernorm,
+    param_specs,
+)
+
+
+# --------------------------------------------------------------------- layout
+
+def stack_layers(params):
+    """List-of-layer-dicts -> single stacked pytree with leading layer axis
+    (the axis ``pp`` shards).  Non-layer leaves pass through."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params["layers"])
+    return out
+
+
+def unstack_layers(params, n_layers: int):
+    """Inverse of :func:`stack_layers` (checkpoint interchange with the
+    list-layout ``TransformerLM``)."""
+    out = {k: v for k, v in params.items() if k != "layers"}
+    st = params["layers"]
+    out["layers"] = [jax.tree_util.tree_map(lambda x: x[i], st)
+                     for i in range(n_layers)]
+    return out
+
+
+def pipeline_param_specs(cfg: TransformerConfig):
+    """Stacked-layout PartitionSpecs: the stacked layer axis is sharded over
+    pp; inner axes keep their tp sharding; everything else replicated."""
+    base = param_specs(cfg)
+    specs = {k: v for k, v in base.items() if k != "layers"}
+    specs["layers"] = jax.tree_util.tree_map(
+        lambda s: P(PP, *s), base["layers"][0],
+        is_leaf=lambda x: isinstance(x, P))
+    return specs
+
+
+# --------------------------------------------------------------------- schedule
+
+def pipelined_encode_local(params, tokens, cfg: TransformerConfig, *,
+                           n_pp: int, n_micro: int, n_sp: int = 1,
+                           sp_axis=None, tp_axis=None):
+    """Final hidden states for the local (dp/sp-sharded) token block, the
+    layer stack executed as an ``n_pp``-stage, ``n_micro``-micro-batch
+    pipeline.  Runs inside shard_map.  Every rank returns the same-shaped
+    output; only the LAST rank's is the real sequence encoding (callers
+    mask with ``lax.axis_index(PP)``)."""
+    B, T = tokens.shape
+    assert B % n_micro == 0, f"local batch {B} % n_micro {n_micro}"
+    stage = lax.axis_index(PP)
+
+    # Embedding on every rank (SPMD; a gather — cheap), used only by rank 0.
+    my_sp = lax.axis_index(sp_axis) if sp_axis else 0
+    pos0 = my_sp * T
+    x = jnp.take(params["tok_embed"], tokens, axis=0)
+    pos = lax.dynamic_slice_in_dim(params["pos_embed"], pos0, T, axis=0)
+    x = (x + pos[None]).astype(cfg.dtype)
+
+    bm = B // n_micro
+    micro = x.reshape(n_micro, bm, T, x.shape[-1])
+
+    stacked = params["layers"]                    # (L_loc, ...) leaves
+
+    def apply_stage(h):
+        def body(carry, lp):
+            out = _block(lp, carry, cfg, n_sp, sp_axis, tp_axis, T)
+            return out, None
+        body_fn = jax.checkpoint(body) if cfg.remat else body
+        h, _ = lax.scan(body_fn, h, stacked)
+        return h
+
+    n_ticks = n_micro + n_pp - 1
+    right = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        x0 = lax.dynamic_index_in_dim(micro, jnp.clip(t, 0, n_micro - 1), 0,
+                                      keepdims=False)
+        xin = jnp.where(stage == 0, x0, recv)
+        y = apply_stage(xin)
+        out_idx = jnp.clip(t - (n_pp - 1), 0, n_micro - 1)
+        updated = lax.dynamic_update_index_in_dim(outs, y, out_idx, 0)
+        outs = jnp.where(t >= n_pp - 1, updated, outs)
+        recv = lax.ppermute(y, PP, right)
+        return (recv, outs), None
+
+    outs0 = jnp.zeros_like(micro)
+    recv0 = jnp.zeros_like(micro[0])
+    (_, outs), _ = lax.scan(tick, (recv0, outs0), jnp.arange(n_ticks))
+
+    h = outs.reshape(B, T, x.shape[-1])
+    return _layernorm(h, params["final_ln_scale"], params["final_ln_bias"])
+
+
+def pipelined_lm_loss_local(params, tokens, targets, cfg: TransformerConfig,
+                            *, n_pp: int, n_micro: int, **axes):
+    """Local masked LM loss: real on the last pp rank, 0 elsewhere; callers
+    ``psum`` over pp (exactly one rank contributes) then pmean over dp/sp."""
+    h = pipelined_encode_local(params, tokens, cfg, n_pp=n_pp,
+                               n_micro=n_micro, **axes)
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", h.astype(cfg.dtype),
+                        head.astype(cfg.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    is_last = lax.axis_index(PP) == n_pp - 1
+    return jnp.where(is_last, loss, 0.0)
+
+
+# --------------------------------------------------------------------- facade
+
+class PipelinedTransformerLM(TransformerLM):
+    """Flagship trainer with the pp axis live: (dp, pp, sp, tp) explicit
+    SPMD.  Param layout is the STACKED one (use :func:`stack_layers` /
+    :func:`unstack_layers` to interchange with ``TransformerLM``)."""
+
+    def __init__(self, cfg: TransformerConfig, mesh, n_micro: int | None = None):
+        super().__init__(cfg, mesh)
+        s = mesh.shape
+        self.n_pp = s.get(PP, 1)
+        assert self.n_pp > 1, "use TransformerLM when pp == 1"
+        assert cfg.n_layers % self.n_pp == 0, (
+            f"n_layers {cfg.n_layers} % pp {self.n_pp}")
+        self.n_micro = n_micro if n_micro is not None else 2 * self.n_pp
+
+    def init(self, key=None) -> dict:
+        return stack_layers(super().init(key))
+
+    def _specs(self):
+        return pipeline_param_specs(self.cfg)
+
+    def init_opt(self, params, tx=None, lr: float = 1e-3, specs=None):
+        return super().init_opt(params, tx, lr,
+                                specs=specs if specs is not None else self._specs())
+
+    def place(self, tree, specs=None):
+        return super().place(tree, specs if specs is not None else self._specs())
+
+    def _grad_sync(self, specs, sp_axis, tp_axis):
+        """dp/sp replicas hold full per-shard grads -> pmean; pp holds
+        PARTIAL contributions on pp-replicated leaves -> psum (stage-sharded
+        leaves already have their full grad locally)."""
+        base = super()._grad_sync(specs, sp_axis, tp_axis)
+
+        def sync(grads):
+            grads = base(grads)
+
+            def pp_fix(g, spec):
+                if any(ax == PP for ax in spec if ax is not None):
+                    return g
+                return lax.psum(g, PP)
+
+            return jax.tree_util.tree_map(
+                pp_fix, grads, specs, is_leaf=lambda x: isinstance(x, P))
+
+        return sync
+
+    def _loss_reduce(self, loss, sp_axis):
+        """Exactly one pp rank (the last) holds the real loss; psum over pp
+        recovers it, then the usual dp/sp pmean applies."""
+        return super()._loss_reduce(lax.psum(loss, PP), sp_axis)
+
+    def build_train_step(self, tx=None, lr: float = 1e-3):
+        """``step(params, opt, tokens, targets) -> (params, opt, loss)``
+        with the layer stack pipelined over pp (shared ``_build_step``
+        wiring; only the loss fn, specs, and reductions differ)."""
+        cfg = self.cfg
+        tx = tx if tx is not None else self._default_tx(lr)
+        n_pp, n_micro = self.n_pp, self.n_micro
+
+        def loss_of(params, tokens, targets, axes):
+            return pipelined_lm_loss_local(params, tokens, targets, cfg,
+                                           n_pp=n_pp, n_micro=n_micro, **axes)
+
+        return self._build_step(tx, loss_of, self._specs(),
+                                (P(DP, SP), P(DP, SP)))
+
+    # -- inherited entry points that assume the list layer layout ---------
+    def _stacked_layout_error(self, name):
+        raise NotImplementedError(
+            f"{name} assumes the list layer layout; convert with "
+            "unstack_layers(params, cfg.n_layers) and use TransformerLM, "
+            "or use build_train_step on this class")
+
+    def forward(self, params, tokens):
+        self._stacked_layout_error("forward")
+
+    def init_finetune(self, key, n_classes, params=None):
+        self._stacked_layout_error("init_finetune")
+
+    def build_finetune_step(self, tx=None, lr: float = 2e-5):
+        self._stacked_layout_error("build_finetune_step")
+
+    def fit(self, *args, **kw):
+        self._stacked_layout_error("fit")

@@ -406,6 +406,12 @@ class TransformerLM:
         spec_fn = tx.state_spec or (lambda _: ())
         return (P(), spec_fn(ps))
 
+    def _loss_reduce(self, loss, sp_axis):
+        """Cross-replica reduction of the reported loss (subclasses with
+        extra axes — e.g. the pp pipeline — extend this)."""
+        loss = lax.pmean(loss, DP)
+        return lax.pmean(loss, SP) if sp_axis else loss
+
     def _grad_sync(self, specs, sp_axis, tp_axis):
         """Cross-replica gradient pmean over every axis a param is
         REPLICATED on (dp+sp always; tp for tp-replicated leaves)."""
@@ -450,7 +456,7 @@ class TransformerLM:
             count, tx_state = opt
             loss, grads = jax.value_and_grad(
                 lambda t: loss_of(t, *data, axes=axes))(tree)
-            loss = lax.pmean(lax.pmean(loss, DP), SP) if sp_axis else lax.pmean(loss, DP)
+            loss = self._loss_reduce(loss, sp_axis)
             grads = sync(grads)
             updates, tx_state = tx.update(grads, tx_state, tree, count)
             tree = apply_updates(tree, updates)
