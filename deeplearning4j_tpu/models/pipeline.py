@@ -43,6 +43,8 @@ from .transformer import (
     TransformerLM,
     _block,
     _layernorm,
+    embed_local,
+    lm_head_loss,
     param_specs,
 )
 
@@ -94,11 +96,7 @@ def pipelined_encode_local(params, tokens, cfg: TransformerConfig, *,
     stage = lax.axis_index(PP)
 
     # Embedding on every rank (SPMD; a gather — cheap), used only by rank 0.
-    my_sp = lax.axis_index(sp_axis) if sp_axis else 0
-    pos0 = my_sp * T
-    x = jnp.take(params["tok_embed"], tokens, axis=0)
-    pos = lax.dynamic_slice_in_dim(params["pos_embed"], pos0, T, axis=0)
-    x = (x + pos[None]).astype(cfg.dtype)
+    x = embed_local(params, tokens, cfg, sp_axis)
 
     bm = B // n_micro
     micro = x.reshape(n_micro, bm, T, x.shape[-1])
@@ -142,12 +140,7 @@ def pipelined_lm_loss_local(params, tokens, targets, cfg: TransformerConfig,
     ``psum`` over pp (exactly one rank contributes) then pmean over dp/sp."""
     h = pipelined_encode_local(params, tokens, cfg, n_pp=n_pp,
                                n_micro=n_micro, **axes)
-    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("btd,dv->btv", h.astype(cfg.dtype),
-                        head.astype(cfg.dtype)).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    loss = lm_head_loss(params, h, targets, cfg)
     is_last = lax.axis_index(PP) == n_pp - 1
     return jnp.where(is_last, loss, 0.0)
 

@@ -278,17 +278,36 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
     return x + down.astype(x.dtype)
 
 
-def encode_local(params, tokens, cfg: TransformerConfig, *,
-                 n_sp: int = 1, sp_axis: str | None = None,
-                 tp_axis: str | None = None) -> jnp.ndarray:
-    """Final hidden states (B_loc, T_loc, D) for the local token shard —
-    runs inside shard_map (or standalone when all axes are trivial)."""
+def embed_local(params, tokens, cfg: TransformerConfig,
+                sp_axis: str | None = None) -> jnp.ndarray:
+    """Token + position embedding for the local (sp-offset) token shard —
+    shared by the plain and pipelined forward paths."""
     B, T = tokens.shape
     my_sp = lax.axis_index(sp_axis) if sp_axis else 0
     pos0 = my_sp * T
     x = jnp.take(params["tok_embed"], tokens, axis=0)
     pos = lax.dynamic_slice_in_dim(params["pos_embed"], pos0, T, axis=0)
-    x = (x + pos[None]).astype(cfg.dtype)
+    return (x + pos[None]).astype(cfg.dtype)
+
+
+def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
+    """Mean token cross entropy of final hidden states against targets
+    (tied or separate head) — shared by the plain and pipelined paths."""
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", h.astype(cfg.dtype),
+                        head.astype(cfg.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def encode_local(params, tokens, cfg: TransformerConfig, *,
+                 n_sp: int = 1, sp_axis: str | None = None,
+                 tp_axis: str | None = None) -> jnp.ndarray:
+    """Final hidden states (B_loc, T_loc, D) for the local token shard —
+    runs inside shard_map (or standalone when all axes are trivial)."""
+    T = tokens.shape[1]
+    x = embed_local(params, tokens, cfg, sp_axis)
 
     block = _block
     if cfg.remat:
@@ -313,10 +332,8 @@ def forward_local(params, tokens, cfg: TransformerConfig, *,
 def lm_loss_local(params, tokens, targets, cfg: TransformerConfig, **axes):
     """Mean next-token (or MLM-style given targets) cross entropy on the
     local shard; caller pmean's across dp/sp."""
-    logits = forward_local(params, tokens, cfg, **axes)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    h = encode_local(params, tokens, cfg, **axes)
+    return lm_head_loss(params, h, targets, cfg)
 
 
 def init_cls_head(key, cfg: TransformerConfig, n_classes: int):

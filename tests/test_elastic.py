@@ -1,0 +1,220 @@
+"""End-to-end elastic recovery (SURVEY.md §5.3/§5.4).
+
+The reference's behavior under worker death (``MasterActor.java:123-153``):
+heartbeats stop -> master evicts the stale worker -> its in-flight job is
+re-routed -> training completes as if uninterrupted.  These tests kill a
+real worker thread mid-run and assert the full chain, ending in *model
+parity* with an uninterrupted run — and the checkpoint flavor: crash the
+trainer process state mid-stream, restore, and match the uninterrupted
+trajectory exactly.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.scaleout import (
+    CollectionJobIterator, DistributedRunner, StateTracker)
+from deeplearning4j_tpu.parallel.trainer import DataParallelTrainer
+
+
+# --------------------------------------------------------------------------
+# DistributedRunner: kill a worker thread mid-run
+# --------------------------------------------------------------------------
+
+class DeltaPerformer:
+    """Param-averaging-style performer whose final model is ORDER-FREE:
+    each job adds a deterministic delta to the current model, so the final
+    model equals init + sum(deltas) iff every job ran exactly once —
+    re-routing bugs (lost or duplicated orphans) change the sum."""
+
+    def __init__(self, tracker: StateTracker):
+        self.tracker = tracker
+
+    def perform(self, job):
+        current = self.tracker.get_current()
+        base = np.zeros(4) if current is None else np.asarray(current)
+        job.result = base + np.full(4, float(job.work))
+
+    def update(self, *args):
+        pass
+
+
+class DyingPerformer(DeltaPerformer):
+    """First worker to pick a job dies mid-perform (thread exits with the
+    job still assigned and heartbeats stopped) — the thread-level analog of
+    SIGKILL on a worker node."""
+
+    died = None          # class-level: worker_id that died
+    _lock = threading.Lock()
+
+    def perform(self, job):
+        with DyingPerformer._lock:
+            if DyingPerformer.died is None:
+                DyingPerformer.died = job.worker_id
+                raise RuntimeError("simulated worker death")
+        super().perform(job)
+
+
+def _run_jobs(performer_factory, jobs, n_workers, eviction_timeout_s=120.0):
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(4))
+    runner = DistributedRunner(
+        CollectionJobIterator(jobs),
+        performer_factory,
+        n_workers=n_workers,
+        tracker=tracker,
+        eviction_timeout_s=eviction_timeout_s,
+    )
+    result = runner.run(max_wall_s=60.0)
+    return np.asarray(result), tracker
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_death_evict_requeue_parity():
+    """Kill one of two workers mid-job; master must evict it, re-route the
+    orphaned job, and finish with the same model as an uninterrupted run."""
+    DyingPerformer.died = None
+    jobs = [1.0, 2.0, 3.0, 4.0, 5.0]
+
+    ref, _ = _run_jobs(DeltaPerformer, jobs, n_workers=1)
+
+    got, tracker = _run_jobs(DyingPerformer, jobs, n_workers=2,
+                             eviction_timeout_s=0.5)
+    # the dying worker was evicted...
+    assert DyingPerformer.died is not None
+    assert DyingPerformer.died not in tracker.workers()
+    # ...its orphaned job was re-routed and the final model matches
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+    assert tracker.is_done()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_all_jobs_survive_death_no_duplicates():
+    """With 3 workers (one dying), every job still executes EXACTLY once —
+    the orphan is re-routed, not lost, not duplicated.  (Model parity is
+    not asserted here: with >1 surviving worker the iterative-reduce wave
+    AVERAGE legitimately depends on wave grouping.)"""
+    DyingPerformer.died = None
+    jobs = [float(i) for i in range(1, 9)]
+    executed: list[float] = []
+    lock = threading.Lock()
+
+    class Recording(DyingPerformer):
+        def perform(self, job):
+            super().perform(job)          # raises once for the dying worker
+            with lock:
+                executed.append(float(job.work))
+
+    _, tracker = _run_jobs(Recording, jobs, n_workers=3,
+                           eviction_timeout_s=0.5)
+    assert sorted(executed) == jobs       # exactly once each, incl. orphan
+    assert DyingPerformer.died not in tracker.workers()
+    assert tracker.is_done()
+
+
+# --------------------------------------------------------------------------
+# DataParallelTrainer: crash mid-stream, restore from checkpoint, match the
+# uninterrupted trajectory
+# --------------------------------------------------------------------------
+
+def _toy_problem():
+    w_true = jnp.asarray([1.0, -2.0, 0.5])
+    x = jax.random.normal(jax.random.key(3), (64, 3))
+    y = x @ w_true
+    params = {"w": jnp.zeros(3)}
+
+    def loss_fn(p, xb, yb, key=None):
+        pred = xb @ p["w"]
+        return jnp.mean((pred - yb) ** 2)
+
+    return params, loss_fn, x, y
+
+
+class _Batch:
+    def __init__(self, x, y):
+        self.features, self.labels = x, y
+
+
+def _batches(x, y, n=8, bs=8):
+    return [_Batch(x[i * bs:(i + 1) * bs], y[i * bs:(i + 1) * bs])
+            for i in range(n)]
+
+
+def test_trainer_crash_restore_parity(tmp_path):
+    """8 uninterrupted steps == 4 steps + process 'crash' (state discarded)
+    + checkpoint restore + 4 more steps, exactly."""
+    mesh = make_mesh(MeshSpec(dp=8), devices=jax.devices()[:8])
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+
+    def new_trainer():
+        return DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
+                                                    T.sgd_lr(5e-2)),
+                                   mesh=mesh)
+
+    # uninterrupted reference: epochs=1 over the 8 batches = 8 steps
+    t_ref = new_trainer()
+    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data,
+                                  epochs=1)
+    assert len(ref_losses) == len(data)
+
+    # interrupted run: stop (crash) after 4 steps, checkpointing every 2
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=2)
+    t1 = new_trainer()
+    s1 = t1.init_state(params)
+    for _ in range(4):
+        b = data[s1.step % len(data)]
+        s1, _ = t1.step(s1, b.features, b.labels)
+        if s1.step % 2 == 0:
+            t1.checkpoint(s1, mgr)
+    del t1, s1                                # the "crash": state is gone
+    assert mgr.latest_step() == 4
+
+    # fresh process: restore and continue to the same total step count
+    t2 = new_trainer()
+    s2, _ = t2.fit(t2.init_state(params), data, epochs=1,
+                   checkpoint_manager=mgr, resume=True)
+
+    assert s2.step == s_ref.step
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_trainer_restore_includes_optimizer_state(tmp_path):
+    """Momentum buffers survive the crash: a restore that silently zeroed
+    them would diverge from the uninterrupted trajectory."""
+    mesh = make_mesh(MeshSpec(dp=8), devices=jax.devices()[:8])
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+    tx = T.chain(T.momentum(0.9), T.sgd_lr(5e-2))
+
+    t = DataParallelTrainer(loss_fn, tx, mesh=mesh)
+    s = t.init_state(params)
+    for _ in range(3):
+        b = data[s.step % len(data)]
+        s, _ = t.step(s, b.features, b.labels)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    t.checkpoint(s, mgr)
+
+    t2 = DataParallelTrainer(loss_fn, tx, mesh=mesh)
+    s2 = t2.restore(t2.init_state(params), mgr)
+    # momentum buffer must be nonzero and equal to the pre-crash one
+    mom_a = jax.tree_util.tree_leaves(s.tstate)
+    mom_b = jax.tree_util.tree_leaves(s2.tstate)
+    nonzero = False
+    for a, b in zip(mom_a, mom_b):
+        if isinstance(a, (jnp.ndarray, np.ndarray)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-7)
+            nonzero = nonzero or float(np.abs(np.asarray(a)).sum()) > 0
+    assert nonzero
