@@ -1,0 +1,131 @@
+"""Annotator pipeline tests: POS tagger, Porter stemmer, sentence
+annotator, and their integration with windows + Viterbi (the reference's
+UIMA pipeline roles: PoStagger.java, StemmerAnnotator.java,
+SentenceAnnotator.java, TokenizerAnnotator.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text.annotator import (
+    AveragedPerceptronTagger, PorterStemmer, SentenceAnnotator,
+    StemmerPreProcess, TokenizerAnnotator, load_tagged_corpus,
+    pos_tag_viterbi, tagged_windows, _DATA)
+from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+
+
+# --------------------------------------------------------------------- stemmer
+
+@pytest.mark.parametrize("word,stem", [
+    ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+    ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+    ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+    ("troubled", "troubl"), ("sized", "size"), ("hopping", "hop"),
+    ("falling", "fall"), ("hissing", "hiss"), ("happy", "happi"),
+    ("relational", "relat"), ("conditional", "condit"),
+    ("vietnamization", "vietnam"), ("predication", "predic"),
+    ("operator", "oper"), ("callousness", "callous"),
+    ("formaliti", "formal"), ("triplicate", "triplic"),
+    ("formative", "form"), ("formalize", "formal"),
+    ("revival", "reviv"), ("allowance", "allow"), ("inference", "infer"),
+    ("airliner", "airlin"), ("adjustment", "adjust"),
+    ("probate", "probat"), ("rate", "rate"), ("controll", "control"),
+])
+def test_porter_stemmer_known_pairs(word, stem):
+    assert PorterStemmer().stem(word) == stem
+
+
+def test_stemmer_preprocess_plugs_into_tokenizer_factory():
+    factory = DefaultTokenizerFactory(pre=StemmerPreProcess())
+    toks = factory.create("The horses were running happily").get_tokens()
+    assert toks == ["the", "hors", "were", "run", "happili"]
+
+
+# ------------------------------------------------------------------ sentences
+
+def test_sentence_annotator_splits_and_keeps_abbreviations():
+    ann = SentenceAnnotator()
+    text = ("Dr. Smith arrived at 9 a.m. sharp. He greeted Mrs. Jones "
+            "warmly! Did the meeting start on time? It did.")
+    sents = ann.annotate(text)
+    assert len(sents) == 4
+    assert sents[0].startswith("Dr. Smith")
+    assert sents[1].startswith("He greeted")
+    assert sents[2].endswith("time?")
+    assert sents[3] == "It did."
+
+
+def test_sentence_annotator_no_trailing_punctuation():
+    assert SentenceAnnotator()("no punctuation here") == ["no punctuation here"]
+
+
+def test_tokenizer_annotator():
+    assert TokenizerAnnotator()("a b  c") == ["a", "b", "c"]
+
+
+# ----------------------------------------------------------------------- tagger
+
+@pytest.fixture(scope="module")
+def corpus():
+    return load_tagged_corpus(_DATA / "pos_sample.txt")
+
+
+@pytest.fixture(scope="module")
+def tagger(corpus):
+    t = AveragedPerceptronTagger()
+    t.train(corpus[:-8])                       # hold out 8 sentences
+    return t
+
+
+def test_tagger_heldout_accuracy(tagger, corpus):
+    """Generalization across held-out sentences: overwhelmingly right."""
+    right = total = 0
+    for sent in corpus[-8:]:
+        tags = tagger.tag([w for w, _ in sent])
+        for (_, got), (_, gold) in zip(tags, sent):
+            right += got == gold
+            total += 1
+    assert right / total >= 0.85, f"{right}/{total}"
+
+
+def test_tagger_on_unseen_words_uses_suffix_features(tagger):
+    # "strolls" (unseen verb, -s), "misty" (unseen adj, -y pattern via
+    # suffix weights): structure should still resolve determiners/nouns
+    tags = dict(tagger.tag(["the", "misty", "meadow"]))
+    assert tags["the"] == "DET"
+    assert tags["meadow"] == "NOUN"
+
+
+def test_default_tagger_singleton_trains_offline():
+    t = AveragedPerceptronTagger.default()
+    tags = dict(t.tag(["the", "dog", "barks", "loudly", "."]))
+    assert tags["the"] == "DET"
+    assert tags["dog"] == "NOUN"
+    assert tags["barks"] == "VERB"
+    assert tags["loudly"] == "ADV"
+
+
+def test_viterbi_smoothing_matches_greedy_on_easy_text(tagger):
+    tokens = ["the", "small", "cat", "sleeps", "."]
+    greedy = [t for _, t in tagger.tag(tokens)]
+    smooth = [t for _, t in pos_tag_viterbi(tokens, tagger)]
+    assert smooth == greedy == ["DET", "ADJ", "NOUN", "VERB", "."]
+
+
+def test_emissions_are_distributions(tagger):
+    probs = tagger.emissions(["the", "cat"])
+    assert probs.shape == (2, len(tagger.classes))
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-6)
+
+
+# ------------------------------------------------------------------ windows
+
+def test_tagged_windows_feed_window_pipeline(tagger):
+    tokens = ["the", "quick", "fox", "jumps"]
+    wins = tagged_windows(tokens, tagger, window_size=3)
+    assert len(wins) == len(tokens)
+    (w0, label0) = wins[0]
+    assert w0.focus == "the"
+    assert label0 == "DET"
+    (w2, label2) = wins[2]
+    assert w2.focus == "fox"
+    assert label2 == "NOUN"
