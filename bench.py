@@ -21,8 +21,12 @@ because async dispatch on the tunneled platform returned before execution):
    only on TPU (the CPU ``peak`` is a nominal constant and the CPU fallback
    is a smoke signal, not a claim — there they demote to warnings).
 4. Analytic FLOPs are cross-checked against XLA's own ``cost_analysis()``.
-5. Batches rotate through a pool of host-staged arrays (device_put inside
-   the loop), so the number includes host->device transfer overlap.
+5. The BERT leg is timed TWICE: once end-to-end with ``device_put`` inside
+   the loop (transfers fully serialized into each step — an upper bound on
+   input-pipeline cost, honest about the tunneled link) and once with the
+   batch pool pre-staged on device (pure compute throughput — what an
+   overlapped input pipeline achieves).  The headline tokens/sec and MFU
+   come from the staged run; the end-to-end run is reported alongside.
 """
 
 from __future__ import annotations
@@ -45,30 +49,93 @@ PEAK_FLOPS = {
 MFU_TARGET = 0.35
 
 
-def _discover_devices(timeout_s: float = 120.0):
+_PROBE_SRC = r"""
+import sys, time
+t0 = time.time()
+import jax
+try:
+    devs = jax.devices("tpu")
+except Exception as e:
+    print(f"explicit tpu probe failed after {time.time()-t0:.0f}s: "
+          f"{type(e).__name__}: {e}", file=sys.stderr)
+    devs = jax.devices()
+d = devs[0]
+print(d.platform, d.device_kind, len(devs), f"{time.time()-t0:.1f}s")
+"""
+
+
+def _discover_devices(attempts: int = None, timeout_s: float = None,
+                      backoff_s: float = 15.0):
     """Probe the TPU backend in a SUBPROCESS (an in-thread probe that hangs
     would wedge jax's backend lock and deadlock the CPU fallback too); only
-    touch the TPU platform in-process once the probe proves it healthy."""
+    touch the TPU platform in-process once the probe proves it healthy.
+
+    Round-4 hardening (r3 fell back after a single 120s probe attempt while
+    r2 proved the chip *can* attach): retry ``attempts`` times with backoff,
+    try ``jax.devices("tpu")`` explicitly, and capture each failed attempt's
+    stderr tail into the artifact so a fallback is diagnosable.
+
+    ``BENCH_PROBE_ATTEMPTS`` / ``BENCH_PROBE_TIMEOUT_S`` env vars override
+    the schedule (defaults 3 x 180s)."""
     import jax
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices()[0]; print(d.platform, d.device_kind)"],
-            capture_output=True, text=True, timeout=timeout_s)
-        ok = proc.returncode == 0 and proc.stdout.strip()
-        reason = None if ok else f"probe rc={proc.returncode}: {proc.stderr[-200:]}"
-    except subprocess.TimeoutExpired:
-        ok, reason = False, f"device discovery hung >{timeout_s:.0f}s"
-    if ok:
-        return jax.devices(), None
+    if attempts is None:
+        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
+
+    def _relay_ports():
+        """TCP-connect scan of the loopback relay's likely ports — pure
+        diagnostic (the claim port lives inside the PJRT plugin): tells a
+        reader of a fallback artifact whether the tunnel was even up."""
+        import socket
+        host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
+        state = {}
+        for port in (8080, 8081, 8082, 8083):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.settimeout(1.0)
+            try:
+                state[port] = "open" if s.connect_ex((host, port)) == 0 else "closed"
+            finally:
+                s.close()
+        return f"relay {host} ports: " + ", ".join(
+            f"{p}={v}" for p, v in state.items())
+
+    failures = []
+    for i in range(attempts):
+        if i:
+            time.sleep(backoff_s)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s)
+            # success = the probe actually reached a TPU-class device; a
+            # probe that fell back to CPU (TPU init RAISED instead of
+            # hanging) is a failed attempt — retrying is the whole point
+            if proc.returncode == 0 and "tpu" in proc.stdout.lower():
+                return jax.devices(), None, failures
+            failures.append(
+                f"attempt {i+1}: rc={proc.returncode} after "
+                f"{time.time()-t0:.0f}s; probe saw: "
+                f"{proc.stdout.strip()[:100]!r}; {_relay_ports()}; "
+                f"stderr: {proc.stderr[-300:]}")
+        except subprocess.TimeoutExpired as e:
+            stderr = (e.stderr or b"")
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode("utf-8", "replace")
+            failures.append(
+                f"attempt {i+1}: hung >{timeout_s:.0f}s; {_relay_ports()}; "
+                f"stderr so far: {stderr[-300:]}")
     jax.config.update("jax_platforms", "cpu")
-    return jax.devices("cpu"), reason
+    reason = f"device discovery failed after {attempts} attempts"
+    return jax.devices("cpu"), reason, failures
 
 
 def _timed_loop(step, params, opt, batches, iters, stage_on_device=False):
     """Run ``iters`` steps rotating batches, syncing to host EVERY
-    iteration.  Returns (iter_times, last_loss).
+    iteration.  Returns (iter_times, last_loss, params, opt) — params/opt
+    are threaded back out because train steps donate their input buffers.
 
     ``float(np.asarray(loss))`` inside the loop is the synchronization an
     async/misbehaving platform cannot fake: the scalar cannot arrive on
@@ -91,7 +158,7 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False):
         params, opt, loss = step(params, opt, a, b)
         loss = float(np.asarray(loss))           # forced host sync
         iter_times.append(time.perf_counter() - t0)
-    return iter_times, loss
+    return iter_times, loss, params, opt
 
 
 def _stats(iter_times):
@@ -127,14 +194,21 @@ def _validity_checks(name, iter_times, flops_per_iter, peak):
     return problems, mfu
 
 
-def _bert_leg(dev, on_tpu):
+def _bert_leg(dev, on_tpu, conserve_hbm=False):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig, TransformerLM)
     from deeplearning4j_tpu.optimize import transforms as T
 
-    if on_tpu:
+    if on_tpu and conserve_hbm:
+        # OOM retry path: remat + half batch (main() falls back here when
+        # the full-size leg dies with RESOURCE_EXHAUSTED)
+        batch, seq, iters = 32, 512, 16
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
+                                n_layers=12, d_ff=3072, max_len=seq,
+                                causal=False, dtype=jnp.bfloat16, remat=True)
+    elif on_tpu:
         # remat off: BERT-base at this batch fits v5e HBM comfortably and
         # remat's recompute would burn ~1/3 more FLOPs for nothing.
         batch, seq, iters = 64, 512, 16
@@ -175,13 +249,20 @@ def _bert_leg(dev, on_tpu):
         except Exception:
             pass
 
-        iter_times, last_loss = _timed_loop(step, params, opt, batches, iters)
+        # end-to-end first (device_put serialized into each step), then the
+        # device-staged run the headline is computed from (see module doc #5)
+        e2e_times, _, params, opt = _timed_loop(step, params, opt, batches, iters)
+        iter_times, last_loss, params, opt = _timed_loop(
+            step, params, opt, batches, iters, stage_on_device=True)
 
     st = _stats(iter_times)
+    e2e = _stats(e2e_times)
     return {
         "name": "bert_base", "iters": iters, "batch": batch, "seq": seq,
         "iter_times": iter_times, "stats": st,
+        "e2e_stats": e2e,
         "tokens_per_sec": batch * seq / st["median_s"],
+        "tokens_per_sec_e2e": batch * seq / e2e["median_s"],
         "flops_per_iter": cfg.flops_per_token() * batch * seq,
         "flops_per_token_analytic": cfg.flops_per_token(),
         "xla_flops_per_step": xla_flops,
@@ -189,7 +270,7 @@ def _bert_leg(dev, on_tpu):
     }
 
 
-def _resnet_leg(dev, on_tpu):
+def _resnet_leg(dev, on_tpu, batch_override=None):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.resnet import (
@@ -199,7 +280,7 @@ def _resnet_leg(dev, on_tpu):
 
     if on_tpu:
         cfg = ResNetConfig.resnet50()
-        batch, size, iters = 64, 224, 12
+        batch, size, iters = batch_override or 64, 224, 12
     else:
         cfg = ResNetConfig.resnet18(num_classes=10, dtype=jnp.float32)
         batch, size, iters = 4, 64, 3
@@ -226,7 +307,7 @@ def _resnet_leg(dev, on_tpu):
         a, b = map(jax.device_put, batches[0])
         params, opt, loss = jstep(params, opt, a, b)
         float(np.asarray(loss))
-        iter_times, last_loss = _timed_loop(
+        iter_times, last_loss, params, opt = _timed_loop(
             jstep, params, opt, batches, iters, stage_on_device=True)
 
     st = _stats(iter_times)
@@ -327,9 +408,72 @@ def _scaling_leg(timeout_s: float = 420.0):
     }
 
 
+_REAL_CONFIG_CHILD = r"""
+import json, sys
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+out = {}
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig, TransformerLM
+from deeplearning4j_tpu.optimize import transforms as T
+cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12, n_layers=12,
+                        d_ff=3072, max_len=512, causal=False,
+                        dtype=jnp.bfloat16, remat=False)
+model = TransformerLM(cfg)
+tx = T.adamw(T.warmup_cosine(1e-4, 10, 1000), weight_decay=0.01)
+params = model.init(jax.random.key(0))
+opt = model.init_opt(params, tx)
+step = model.build_train_step(tx)
+toks = jnp.zeros((8, 512), jnp.int32)
+compiled = step.lower(params, opt, toks, toks).compile()
+cost = compiled.cost_analysis()
+c = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+out["bert_base"] = {"compiled": True, "batch": 8, "seq": 512,
+                    "n_params": n_params,
+                    "xla_flops": float(c.get("flops", -1.0)) if c else None}
+
+from deeplearning4j_tpu.models.resnet import (ResNetConfig, cross_entropy,
+                                              init_params)
+rcfg = ResNetConfig.resnet50()
+rparams = init_params(jax.random.key(1), rcfg)
+fwd = jax.jit(jax.value_and_grad(cross_entropy))
+imgs = jnp.zeros((8, 224, 224, 3), rcfg.dtype)
+lbls = jnp.zeros((8, rcfg.num_classes), jnp.float32)
+rcompiled = fwd.lower(rparams, imgs, lbls, rcfg).compile()
+rcost = rcompiled.cost_analysis()
+rc = rcost[0] if isinstance(rcost, (list, tuple)) else (rcost or {})
+rn_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(rparams))
+out["resnet50"] = {"compiled": True, "batch": 8, "image": 224,
+                   "n_params": rn_params,
+                   "xla_flops": float(rc.get("flops", -1.0)) if rc else None}
+print(json.dumps(out))
+"""
+
+
+def _real_config_compile_check(timeout_s: float = 540.0):
+    """CPU-fallback rounds used to carry zero signal about the real
+    benchmark configs (r3 weak #2): lower + compile BERT-base (768/12L/512)
+    and ResNet-50 (224px) on host CPU — no timing claim, but it proves the
+    real graphs build, and records XLA's FLOPs for them."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _REAL_CONFIG_CHILD],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        return {"error": str(e)[:300]}
+
+
 def main():
     t_start = time.time()
-    devices, fallback_reason = _discover_devices()
+    devices, fallback_reason, probe_failures = _discover_devices()
     dev = devices[0]
     kind = getattr(dev, "device_kind", "cpu").lower()
     on_tpu = "tpu" in kind or dev.platform == "tpu"
@@ -339,14 +483,23 @@ def main():
     problems = []
 
     try:
-        bert = _bert_leg(dev, on_tpu)
+        try:
+            bert = _bert_leg(dev, on_tpu)
+        except Exception as oom:
+            if on_tpu and "RESOURCE_EXHAUSTED" in repr(oom):
+                bert = _bert_leg(dev, on_tpu, conserve_hbm=True)
+                bert["hbm_fallback"] = "remat+batch32 after RESOURCE_EXHAUSTED"
+            else:
+                raise
     except Exception as e:
         # Headline leg failed (OOM, tunnel death mid-run, compile error):
         # still honor the one-JSON-line contract, publish no claim, fail.
         out = {"metric": "bert_base_train_tokens_per_sec_ERROR", "value": 0.0,
                "unit": "tokens/sec/chip", "vs_baseline": 0.0,
                "extra": {"device": str(dev), "error": repr(e)[:400],
-                         "wall_s": round(time.time() - t_start, 1)}}
+                         "wall_s": round(time.time() - t_start, 1),
+                         **({"probe_failures": probe_failures}
+                            if probe_failures else {})}}
         print(json.dumps(out))
         print(f"BENCH ERROR: {e!r}", file=sys.stderr)
         sys.exit(1)
@@ -362,7 +515,13 @@ def main():
                 f"bert: analytic FLOPs {ratio:.2f}x XLA cost_analysis")
 
     try:
-        resnet = _resnet_leg(dev, on_tpu)
+        try:
+            resnet = _resnet_leg(dev, on_tpu)
+        except Exception as oom:
+            if on_tpu and "RESOURCE_EXHAUSTED" in repr(oom):
+                resnet = _resnet_leg(dev, on_tpu, batch_override=24)
+            else:
+                raise
         rn_problems, rn_mfu = _validity_checks(
             "resnet", resnet["iter_times"], resnet["flops_per_iter"], peak)
         problems += rn_problems
@@ -370,6 +529,9 @@ def main():
         resnet, rn_mfu = {"error": repr(e)[:300]}, None
 
     scaling = _scaling_leg()
+    # when we could not reach the chip, at least prove the REAL configs
+    # compile and record XLA's FLOPs for them (no timing claim)
+    real_compile = None if on_tpu else _real_config_compile_check()
 
     bst = bert["stats"]
     metric = ("bert_base_train_tokens_per_sec" if on_tpu
@@ -381,7 +543,13 @@ def main():
                     "p10": round(bst["p10_s"] * 1e3, 2),
                     "p90": round(bst["p90_s"] * 1e3, 2),
                     "iters": bert["iters"]},
+        "e2e_with_transfers": {
+            "tokens_per_sec": round(bert["tokens_per_sec_e2e"], 1),
+            "step_ms_median": round(bert["e2e_stats"]["median_s"] * 1e3, 2)},
         "loss": round(bert["last_loss"], 4),
+        **({"hbm_fallback": bert["hbm_fallback"]}
+           if "hbm_fallback" in bert else {}),
+        "batch_seq": [bert["batch"], bert["seq"]],
         "flops_per_token": round(bert["flops_per_token_analytic"]),
         **({"flops_analytic_over_xla": bert["flops_analytic_over_xla"]}
            if "flops_analytic_over_xla" in bert else {}),
@@ -393,8 +561,10 @@ def main():
                     "loss": round(resnet["last_loss"], 4)}
                    if "error" not in resnet else resnet),
         "scaling_efficiency": scaling,
+        **({"real_config_compile_check": real_compile} if real_compile else {}),
         "wall_s": round(time.time() - t_start, 1),
         **({"fallback": fallback_reason} if fallback_reason else {}),
+        **({"probe_failures": probe_failures} if probe_failures else {}),
     }
 
     if problems and not on_tpu:
