@@ -9,6 +9,7 @@ from .dataset import DataSet
 from .fetchers import (
     BaseDataFetcher,
     CSVDataFetcher,
+    CurvesDataFetcher,
     DigitsDataFetcher,
     IrisDataFetcher,
     LFWDataFetcher,
@@ -17,6 +18,7 @@ from .fetchers import (
 from .iterator import (
     BaseDatasetIterator,
     CSVDataSetIterator,
+    CurvesDataSetIterator,
     DataSetIterator,
     DigitsDataSetIterator,
     IrisDataSetIterator,
@@ -31,9 +33,9 @@ from .iterator import (
 
 __all__ = [
     "DataSet",
-    "BaseDataFetcher", "CSVDataFetcher", "DigitsDataFetcher",
+    "BaseDataFetcher", "CSVDataFetcher", "CurvesDataFetcher", "DigitsDataFetcher",
     "IrisDataFetcher", "LFWDataFetcher", "MnistDataFetcher",
-    "BaseDatasetIterator", "CSVDataSetIterator", "DataSetIterator",
+    "BaseDatasetIterator", "CSVDataSetIterator", "CurvesDataSetIterator", "DataSetIterator",
     "DigitsDataSetIterator", "IrisDataSetIterator", "ListDataSetIterator",
     "MnistDataSetIterator", "MovingWindowDataSetIterator",
     "MultipleEpochsIterator", "ReconstructionDataSetIterator",

@@ -113,13 +113,19 @@ class MnistDataFetcher(BaseDataFetcher):
     """Full MNIST via local IDX files (``MnistDataFetcher.java:21-80``,
     ``base/MnistFetcher.java:30``).
 
-    Looks for ``train-images-idx3-ubyte[.gz]`` etc. under ``data_dir``;
+    Search order for ``train-images-idx3-ubyte[.gz]`` etc.: the vendored
+    repo fixture (``datasets/fixtures/mnist`` — materialized by
+    ``tools/vendor_mnist.py`` on a machine with egress), then ``data_dir``;
     attempts download when ``allow_download`` (no egress here, so default
-    False); else falls back to the bundled digits corpus upscaled to 28x28,
-    keeping MNIST-shaped pipelines runnable offline.
+    False).  Otherwise falls back to the bundled digits corpus upscaled to
+    28x28 so MNIST-shaped pipelines still run offline — the fallback is
+    LOUD: ``source`` is set to ``"digits_fallback"``, a warning is emitted,
+    and ``require_real=True`` turns it into an error so a test asserting
+    on real pixels can never silently pass on fake ones.
     """
 
     NUM_EXAMPLES = 60000
+    FIXTURE_DIR = Path(__file__).parent / "fixtures" / "mnist"
     URLS = {
         "train-images-idx3-ubyte.gz": "https://ossci-datasets.s3.amazonaws.com/mnist/train-images-idx3-ubyte.gz",
         "train-labels-idx1-ubyte.gz": "https://ossci-datasets.s3.amazonaws.com/mnist/train-labels-idx1-ubyte.gz",
@@ -129,19 +135,31 @@ class MnistDataFetcher(BaseDataFetcher):
 
     def __init__(self, binarize: bool = True, train: bool = True,
                  data_dir: Path | str | None = None, allow_download: bool = False,
-                 flatten: bool = True):
+                 flatten: bool = True, require_real: bool = False):
         super().__init__()
         self.binarize = binarize
         self.train = train
         self.data_dir = Path(data_dir) if data_dir else DEFAULT_BASE_DIR / "mnist"
+        # an explicitly-passed data_dir must win over the vendored fixture
+        self._search_dirs = ((self.data_dir, self.FIXTURE_DIR) if data_dir
+                             else (self.FIXTURE_DIR, self.data_dir))
         self.allow_download = allow_download
         self.flatten = flatten
+        self.require_real = require_real
+        self.source: str | None = None   # "idx" | "digits_fallback" after load
+
+    @classmethod
+    def real_data_available(cls, data_dir: Path | str | None = None) -> bool:
+        """True when real IDX files are reachable (fixture or data_dir)."""
+        f = cls(train=True, data_dir=data_dir)
+        return f._find("train-images-idx3-ubyte") is not None
 
     def _find(self, stem: str) -> Path | None:
-        for name in (stem, stem + ".gz"):
-            p = self.data_dir / name
-            if p.exists():
-                return p
+        for base in self._search_dirs:
+            for name in (stem, stem + ".gz"):
+                p = base / name
+                if p.exists():
+                    return p
         return None
 
     def _maybe_download(self, stem: str) -> Path | None:
@@ -165,8 +183,22 @@ class MnistDataFetcher(BaseDataFetcher):
             images = read_idx_images(img_path)  # (n, 28, 28) uint8
             labels = read_idx_labels(lbl_path)
             x = images.astype(np.float32) / 255.0
+            self.source = "idx"
         else:
+            if self.require_real:
+                raise FileNotFoundError(
+                    f"real MNIST IDX files not found (looked in "
+                    f"{self.FIXTURE_DIR} and {self.data_dir}) and "
+                    "require_real=True; materialize the fixture with "
+                    "tools/vendor_mnist.py on a machine with egress")
             # Offline fallback: digits upscaled 8x8 -> 28x28 (nearest).
+            import warnings
+            warnings.warn(
+                "MnistDataFetcher: real IDX files absent — falling back to "
+                "sklearn 8x8 digits upscaled to 28x28 (NOT real MNIST "
+                "pixels); run tools/vendor_mnist.py to vendor the fixture",
+                stacklevel=2)
+            self.source = "digits_fallback"
             from sklearn.datasets import load_digits
             d = load_digits()
             imgs = d.images / 16.0
@@ -181,6 +213,44 @@ class MnistDataFetcher(BaseDataFetcher):
         else:
             x = x[..., None]  # NHWC
         return x, to_outcome_matrix(labels, 10)
+
+
+class CurvesDataFetcher(BaseDataFetcher):
+    """Curves dataset (``datasets/fetchers/CurvesDataFetcher.java``): 28x28
+    grayscale images of smooth random curves, the classic deep-autoencoder
+    pretraining corpus.
+
+    The reference downloads a serialized DataSet from S3
+    (``CURVES_URL``); this environment has no egress, so the curves are
+    synthesized directly — each image rasterizes a random cubic Bezier
+    curve (4 control points, deterministic per ``seed``), which is the
+    generative process behind the original corpus.  Labels are the images
+    themselves (reconstruction target), matching its autoencoder use.
+    """
+
+    SIDE = 28
+
+    def __init__(self, n_examples: int = 1000, seed: int = 0):
+        super().__init__()
+        self.n_examples = n_examples
+        self.seed = seed
+
+    def _load(self):
+        rng = np.random.default_rng(self.seed)
+        side = self.SIDE
+        n_steps = 200
+        t = np.linspace(0.0, 1.0, n_steps)[:, None]            # (S, 1)
+        # Bernstein basis for a cubic Bezier
+        basis = np.concatenate([(1 - t) ** 3, 3 * (1 - t) ** 2 * t,
+                                3 * (1 - t) * t ** 2, t ** 3], axis=1)  # (S, 4)
+        imgs = np.zeros((self.n_examples, side, side), np.float32)
+        ctrl = rng.uniform(2, side - 3, (self.n_examples, 4, 2))  # (N, 4, 2)
+        pts = np.einsum("sk,nkd->nsd", basis, ctrl)               # (N, S, 2)
+        ij = np.rint(pts).astype(int)
+        n_idx = np.repeat(np.arange(self.n_examples), n_steps)
+        imgs[n_idx, ij[..., 1].ravel(), ij[..., 0].ravel()] = 1.0
+        flat = imgs.reshape(self.n_examples, side * side)
+        return flat, flat.copy()      # reconstruction corpus: labels = inputs
 
 
 class LFWDataFetcher(BaseDataFetcher):

@@ -19,6 +19,7 @@ from .dataset import DataSet
 from .fetchers import (
     BaseDataFetcher,
     CSVDataFetcher,
+    CurvesDataFetcher,
     DigitsDataFetcher,
     IrisDataFetcher,
     MnistDataFetcher,
@@ -113,6 +114,13 @@ class MnistDataSetIterator(BaseDatasetIterator):
 
     def __init__(self, batch: int = 100, num_examples: int = 0, **kw):
         super().__init__(batch, num_examples, MnistDataFetcher(**kw))
+
+
+class CurvesDataSetIterator(BaseDatasetIterator):
+    """``CurvesDataSetIterator`` (synthesized curves; see the fetcher)."""
+
+    def __init__(self, batch: int = 100, num_examples: int = 0, **kw):
+        super().__init__(batch, num_examples, CurvesDataFetcher(**kw))
 
 
 class CSVDataSetIterator(BaseDatasetIterator):

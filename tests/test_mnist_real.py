@@ -1,0 +1,76 @@
+"""Real-pixel MNIST convergence tests (VERDICT r3 #3).
+
+Mirrors the reference's DBN-on-real-data F1 assertion pattern
+(``nn/multilayer/MultiLayerTest.java:33-70``).  These tests require the
+vendored real MNIST IDX fixture (``deeplearning4j_tpu/datasets/fixtures/
+mnist``) and NEVER run on the upscaled-digits fallback: the fetcher is
+constructed with ``require_real=True``, so fake pixels cannot silently
+satisfy the assertion.
+
+This build container has zero egress and no local MNIST copy (the
+reference's own test resources ship only ``mnist2500_labels.txt`` — labels
+without pixels), so here the tests SKIP with that reason; run
+``tools/vendor_mnist.py`` on any machine with egress to materialize the
+fixture and activate them.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import MnistDataFetcher
+from deeplearning4j_tpu.models.zoo import lenet, mlp
+
+requires_real_mnist = pytest.mark.skipif(
+    not MnistDataFetcher.real_data_available(),
+    reason="real MNIST IDX fixture absent (zero-egress container; "
+           "materialize with tools/vendor_mnist.py)")
+
+
+def _real_mnist(n: int, flatten: bool, train: bool = True) -> DataSet:
+    f = MnistDataFetcher(binarize=False, train=train, flatten=flatten,
+                         require_real=True)
+    f.fetch(n)
+    ds = f.next()
+    assert f.source == "idx"            # fallback can never satisfy this
+    return ds
+
+
+@requires_real_mnist
+def test_fetcher_serves_real_pixels():
+    ds = _real_mnist(512, flatten=True)
+    # real MNIST pixels are 256-level grayscale; the upscaled-digits
+    # fallback only has 17 distinct levels — a cheap authenticity probe
+    assert len(np.unique(ds.features)) > 64
+    assert ds.features.shape == (512, 784)
+    assert ds.labels.shape == (512, 10)
+
+
+@requires_real_mnist
+def test_mlp_f1_on_real_mnist():
+    train = _real_mnist(2048, flatten=True)
+    test = _real_mnist(512, flatten=True, train=False)
+    net = mlp(784, 10, hidden=(128,), num_iterations=300)
+    net.init(jax.random.key(0))
+    net.fit(train)
+    assert net.evaluate(test).f1() > 0.85
+
+
+@requires_real_mnist
+def test_lenet_f1_on_real_mnist():
+    train = _real_mnist(2048, flatten=False)
+    test = _real_mnist(512, flatten=False, train=False)
+    net = lenet(n_classes=10, input_side=28, num_filters=6,
+                num_iterations=250, lr=0.1)
+    net.init(jax.random.key(0))
+    net.fit(train)
+    assert net.evaluate(test).f1() > 0.85
+
+
+@requires_real_mnist
+def test_mnist_iterator_on_real_data():
+    it = MnistDataSetIterator(batch=256, binarize=False)
+    b = it.next()
+    assert b.features.shape[0] == 256

@@ -1,0 +1,78 @@
+"""Vendor a real MNIST IDX subset into the repo as a test fixture.
+
+The build container has ZERO egress (and ships no local MNIST copy — the
+reference's own test resources carry only ``mnist2500_labels.txt``, labels
+without pixels), so the fixture cannot be materialized from inside it.  Run
+this script once from any machine WITH egress; it downloads the canonical
+IDX files, takes a stratified subset, and writes gzipped IDX fixtures that
+``MnistDataFetcher`` and ``tests/test_mnist_real.py`` pick up automatically:
+
+    python tools/vendor_mnist.py            # 6000 train / 1000 test
+    python -m pytest tests/test_mnist_real.py -q   # now runs on real pixels
+
+Mirrors the reference's download+binarize path
+(``datasets/fetchers/MnistDataFetcher.java:21-80``, ``base/MnistFetcher.java:30``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import shutil
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from deeplearning4j_tpu.datasets.fetchers import MnistDataFetcher  # noqa: E402
+from deeplearning4j_tpu.datasets.mnist_idx import (  # noqa: E402
+    read_idx_images, read_idx_labels, write_idx_images, write_idx_labels)
+
+FIXTURE_DIR = (Path(__file__).resolve().parents[1]
+               / "deeplearning4j_tpu" / "datasets" / "fixtures" / "mnist")
+
+
+def _stratified_subset(images, labels, per_class, seed=0):
+    rng = np.random.default_rng(seed)
+    keep = []
+    for c in range(10):
+        idx = np.flatnonzero(labels == c)
+        keep.append(rng.choice(idx, size=min(per_class, idx.size), replace=False))
+    keep = np.sort(np.concatenate(keep))
+    return images[keep], labels[keep]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train", type=int, default=6000, help="train subset size")
+    ap.add_argument("--test", type=int, default=1000, help="test subset size")
+    args = ap.parse_args()
+
+    FIXTURE_DIR.mkdir(parents=True, exist_ok=True)
+    with tempfile.TemporaryDirectory() as td:
+        td = Path(td)
+        for name, url in MnistDataFetcher.URLS.items():
+            print(f"downloading {url}")
+            urllib.request.urlretrieve(url, td / name)  # noqa: S310
+        for split, n in (("train", args.train), ("t10k", args.test)):
+            images = read_idx_images(td / f"{split}-images-idx3-ubyte.gz")
+            labels = read_idx_labels(td / f"{split}-labels-idx1-ubyte.gz")
+            images, labels = _stratified_subset(images, labels, n // 10)
+            for stem, writer, data in (
+                    (f"{split}-images-idx3-ubyte", write_idx_images, images),
+                    (f"{split}-labels-idx1-ubyte", write_idx_labels, labels)):
+                raw = FIXTURE_DIR / stem
+                writer(raw, data)
+                with open(raw, "rb") as fin, gzip.open(
+                        FIXTURE_DIR / (stem + ".gz"), "wb", compresslevel=9) as fout:
+                    shutil.copyfileobj(fin, fout)
+                raw.unlink()
+            print(f"{split}: wrote {labels.shape[0]} examples to {FIXTURE_DIR}")
+
+
+if __name__ == "__main__":
+    main()
