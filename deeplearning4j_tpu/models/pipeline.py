@@ -37,7 +37,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DP, PP, SP
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from ..parallel.mesh import DP, PP, SP, TP
 from .transformer import (
     TransformerConfig,
     TransformerLM,
@@ -119,6 +124,12 @@ def pipelined_encode_local(params, tokens, cfg: TransformerConfig, *,
         x0 = lax.dynamic_index_in_dim(micro, jnp.clip(t, 0, n_micro - 1), 0,
                                       keepdims=False)
         xin = jnp.where(stage == 0, x0, recv)
+        # Zero the garbage lane (fill/drain bubble ticks) BEFORE the stage
+        # runs: a masked-out lane that went non-finite (bf16 overflow) would
+        # poison real gradients through the jnp.where backward (0 * inf =
+        # nan).  Zeros stay finite through the block, so the trap can't arm.
+        valid = (t >= stage) & (t < n_micro + stage)
+        xin = jnp.where(valid, xin, jnp.zeros_like(xin))
         y = apply_stage(xin)
         out_idx = jnp.clip(t - (n_pp - 1), 0, n_micro - 1)
         updated = lax.dynamic_update_index_in_dim(outs, y, out_idx, 0)
@@ -145,6 +156,27 @@ def pipelined_lm_loss_local(params, tokens, targets, cfg: TransformerConfig,
     return jnp.where(is_last, loss, 0.0)
 
 
+def pipelined_cls_loss_local(backbone, head, tokens, labels,
+                             cfg: TransformerConfig, *, n_pp: int,
+                             n_micro: int, n_sp: int = 1, sp_axis=None,
+                             tp_axis=None):
+    """Classifier fine-tune loss through the pipeline (the BERT-fine-tune
+    north star composed with pp): mean-pool the last rank's encoding, dense
+    head, cross entropy — real on the last pp rank, 0 elsewhere (callers
+    psum over pp, as with the LM loss)."""
+    h = pipelined_encode_local(backbone, tokens, cfg, n_pp=n_pp,
+                               n_micro=n_micro, n_sp=n_sp, sp_axis=sp_axis,
+                               tp_axis=tp_axis)
+    pooled = h.astype(jnp.float32).mean(axis=1)
+    if sp_axis:
+        pooled = lax.pmean(pooled, sp_axis)
+    logits = pooled @ head["w_cls"].astype(jnp.float32) + head["b_cls"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    is_last = lax.axis_index(PP) == n_pp - 1
+    return jnp.where(is_last, loss, 0.0)
+
+
 # --------------------------------------------------------------------- facade
 
 class PipelinedTransformerLM(TransformerLM):
@@ -167,12 +199,6 @@ class PipelinedTransformerLM(TransformerLM):
     def _specs(self):
         return pipeline_param_specs(self.cfg)
 
-    def init_opt(self, params, tx=None, lr: float = 1e-3, specs=None):
-        return super().init_opt(params, tx, lr,
-                                specs=specs if specs is not None else self._specs())
-
-    def place(self, tree, specs=None):
-        return super().place(tree, specs if specs is not None else self._specs())
 
     def _grad_sync(self, specs, sp_axis, tp_axis):
         """dp/sp replicas hold full per-shard grads -> pmean; pp holds
@@ -213,21 +239,54 @@ class PipelinedTransformerLM(TransformerLM):
         return self._build_step(tx, loss_of, self._specs(),
                                 (P(DP, SP), P(DP, SP)))
 
-    # -- inherited entry points that assume the list layer layout ---------
-    def _stacked_layout_error(self, name):
-        raise NotImplementedError(
-            f"{name} assumes the list layer layout; convert with "
-            "unstack_layers(params, cfg.n_layers) and use TransformerLM, "
-            "or use build_train_step on this class")
+    def _pipeline_axes(self):
+        s = self.mesh.shape
+        n_sp, n_tp = s.get(SP, 1), s.get(TP, 1)
+        return dict(n_sp=n_sp, sp_axis=SP if n_sp > 1 else None,
+                    tp_axis=TP if n_tp > 1 else None)
 
     def forward(self, params, tokens):
-        self._stacked_layout_error("forward")
+        """Vocabulary logits through the pipeline.  The last pp rank holds
+        the real logits; a pp psum of the masked value replicates them so
+        every rank returns the same (global) array."""
+        if self._fwd is None:
+            cfg, n_pp, n_micro = self.cfg, self.n_pp, self.n_micro
+            axes = self._pipeline_axes()
+
+            def local_fwd(params, tokens):
+                h = pipelined_encode_local(params, tokens, cfg, n_pp=n_pp,
+                                           n_micro=n_micro, **axes)
+                logits = jnp.einsum(
+                    "btd,dv->btv", h.astype(cfg.dtype),
+                    (params["tok_embed"].T if cfg.tie_embeddings
+                     else params["lm_head"]).astype(cfg.dtype)
+                ).astype(jnp.float32)
+                is_last = lax.axis_index(PP) == n_pp - 1
+                return lax.psum(jnp.where(is_last, logits, 0.0), PP)
+
+            self._fwd = jax.jit(shard_map(
+                local_fwd, mesh=self.mesh,
+                in_specs=(self._specs(), P(DP, SP)),
+                out_specs=P(DP, SP), check_vma=False))
+        return self._fwd(params, tokens)
 
     def init_finetune(self, key, n_classes, params=None):
-        self._stacked_layout_error("init_finetune")
+        """Stacked-layout ``{"backbone", "head"}`` tree (inherits the parent
+        wiring: ``init`` already stacks, ``finetune_specs`` routes through
+        ``_specs``)."""
+        return super().init_finetune(key, n_classes, params)
 
     def build_finetune_step(self, tx=None, lr: float = 2e-5):
-        self._stacked_layout_error("build_finetune_step")
+        """Classifier fine-tune step with the layer stack pipelined over pp
+        (the BERT-fine-tune north star composed with pipeline parallelism)."""
+        cfg = self.cfg
+        tx = tx if tx is not None else self._default_tx(lr)
+        n_pp, n_micro = self.n_pp, self.n_micro
 
-    def fit(self, *args, **kw):
-        self._stacked_layout_error("fit")
+        def loss_of(tree, tokens, labels, axes):
+            return pipelined_cls_loss_local(
+                tree["backbone"], tree["head"], tokens, labels, cfg,
+                n_pp=n_pp, n_micro=n_micro, **axes)
+
+        return self._build_step(tx, loss_of, self.finetune_specs(),
+                                (P(DP, SP), P(DP)))

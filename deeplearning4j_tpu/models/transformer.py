@@ -403,6 +403,12 @@ class TransformerLM:
     def _is_finetune_tree(self, tree):
         return isinstance(tree, dict) and set(tree.keys()) == {"backbone", "head"}
 
+    def _specs(self):
+        """Param-tree PartitionSpecs for this model's layer layout
+        (subclasses with a different layout — the stacked pp pipeline —
+        override, and every spec consumer routes through here)."""
+        return param_specs(self.cfg)
+
     def init_opt(self, params, tx=None, lr: float = 1e-3, specs=None):
         """Optimizer state for ``build_train_step``/``build_finetune_step``:
         ``(step_count, tx_state)``, placed onto the mesh with tx-declared
@@ -415,11 +421,11 @@ class TransformerLM:
             return state
         if specs is None:
             specs = (self.finetune_specs() if self._is_finetune_tree(params)
-                     else param_specs(self.cfg))
+                     else self._specs())
         return self.place(state, self.opt_specs(tx, specs))
 
     def opt_specs(self, tx, params_specs=None):
-        ps = params_specs if params_specs is not None else param_specs(self.cfg)
+        ps = params_specs if params_specs is not None else self._specs()
         spec_fn = tx.state_spec or (lambda _: ())
         return (P(), spec_fn(ps))
 
@@ -498,7 +504,7 @@ class TransformerLM:
         def loss_of(params, tokens, targets, axes):
             return lm_loss_local(params, tokens, targets, cfg, **axes)
 
-        return self._build_step(tx, loss_of, param_specs(cfg),
+        return self._build_step(tx, loss_of, self._specs(),
                                 (P(DP, SP), P(DP, SP)))
 
     # -- BERT-style sequence-classification fine-tune -------------------
@@ -510,7 +516,7 @@ class TransformerLM:
         return self.place(tree, self.finetune_specs()) if self.mesh else tree
 
     def finetune_specs(self):
-        return {"backbone": param_specs(self.cfg), "head": cls_head_specs()}
+        return {"backbone": self._specs(), "head": cls_head_specs()}
 
     def build_finetune_step(self, tx=None, lr: float = 2e-5):
         """Classifier fine-tune step (north star: BERT-base fine-tune).
@@ -541,7 +547,7 @@ class TransformerLM:
         tx = tx if tx is not None else self._default_tx(lr)
         step_fn = (self.build_finetune_step(tx) if finetune
                    else self.build_train_step(tx))
-        specs = self.finetune_specs() if finetune else param_specs(self.cfg)
+        specs = self.finetune_specs() if finetune else self._specs()
 
         if (checkpoint_manager is not None and resume
                 and checkpoint_manager.latest_step() is not None):
@@ -572,7 +578,7 @@ class TransformerLM:
         """Device-put a pytree onto the mesh per param_specs."""
         if self.mesh is None:
             return tree
-        specs = specs if specs is not None else param_specs(self.cfg)
+        specs = specs if specs is not None else self._specs()
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
             tree, specs, is_leaf=lambda x: isinstance(x, P))

@@ -115,6 +115,69 @@ def test_pipeline_training_reduces_loss():
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+def test_pp2_finetune_parity_with_single_device():
+    """Classifier fine-tune through the pipeline (VERDICT r3 #5): pp=2
+    fine-tune step == unsharded fine-tune step, loss and all grads/params
+    (backbone AND head)."""
+    cfg = _cfg(n_layers=4, seq=16)
+    tokens, _ = _data(cfg, batch=8, seq=16)
+    labels = jax.random.randint(jax.random.key(7), (8,), 0, 3)
+    tx = T.chain(T.momentum(0.9), T.sgd_lr(1e-2))
+
+    ref_model = TransformerLM(cfg)
+    ref_tree = ref_model.init_finetune(jax.random.key(0), n_classes=3)
+    ref_opt = ref_model.init_opt(ref_tree, tx)
+    ref_step = ref_model.build_finetune_step(tx)
+    ref_tree, _, ref_loss = ref_step(ref_tree, ref_opt, tokens, labels)
+
+    mesh = make_mesh(MeshSpec(dp=2, pp=2, sp=1, tp=2),
+                     devices=jax.devices()[:8])
+    model = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    tree = model.init_finetune(jax.random.key(0), n_classes=3)
+    opt = model.init_opt(tree, tx)
+    step = model.build_finetune_step(tx)
+    tree, _, loss = step(tree, opt, tokens, labels)
+
+    assert abs(float(ref_loss) - float(loss)) < 1e-5
+    got = jax.device_get(tree)
+    got["backbone"] = unstack_layers(got["backbone"], cfg.n_layers)
+    _assert_tree_close(ref_tree, got, atol=1e-5)
+
+
+def test_pp_forward_matches_single_device():
+    """Stacked-layout forward (logits) through the pipeline == TransformerLM
+    forward, replicated to every pp rank."""
+    cfg = _cfg(n_layers=2)
+    tokens, _ = _data(cfg, batch=4, seq=16)
+    ref = TransformerLM(cfg)
+    params = ref.init(jax.random.key(0))
+    want = ref.forward(params, tokens)
+
+    mesh = make_mesh(MeshSpec(dp=2, pp=2, sp=1, tp=1),
+                     devices=jax.devices()[:4])
+    model = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    pp_params = model.place(stack_layers(params))
+    got = model.forward(pp_params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_pp2_finetune_reduces_loss_via_fit():
+    """The inherited fit() convenience loop works with the pp layout."""
+    cfg = _cfg(n_layers=2)
+    tokens, _ = _data(cfg, batch=8, seq=16)
+    labels = jax.random.randint(jax.random.key(3), (8,), 0, 2)
+    mesh = make_mesh(MeshSpec(dp=2, pp=2, sp=1, tp=1),
+                     devices=jax.devices()[:4])
+    model = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    tree = model.init_finetune(jax.random.key(0), n_classes=2)
+    tx = T.chain(T.momentum(0.9), T.sgd_lr(5e-2))
+    opt = model.init_opt(tree, tx)
+    tree, opt, losses = model.fit(tree, opt, [(tokens, labels)], tx=tx,
+                                  epochs=8, finetune=True)
+    assert losses[-1] < losses[0], losses
+
+
 def test_stack_unstack_roundtrip():
     cfg = _cfg()
     params = TransformerLM(cfg).init(jax.random.key(0))
