@@ -274,9 +274,27 @@ class MultiLayerNetwork:
             return jax.value_and_grad(self.supervised_loss)(params, x, y)
 
         out_conf = self.layers[-1].conf
-        solver = Solver(out_conf, objective, listeners=self.listeners,
-                        **({"damping": self.conf.damping_factor}
-                           if algo == OptimizationAlgorithm.HESSIAN_FREE else {}))
+        extra = {}
+        if algo == OptimizationAlgorithm.HESSIAN_FREE:
+            # Gauss-Newton split (the reference CGs on GN products,
+            # StochasticHessianFree.java:27): predict = network up to the
+            # final pre-activation z; loss_out = convex loss of z.
+            from ..ops import activations as _act
+            from ..ops import losses as _losses
+
+            def predict(params, k):
+                h = jnp.asarray(x)
+                for i, (layer, p) in enumerate(zip(self.layers[:-1], params[:-1])):
+                    h = self._preproc(i, layer.activate(p, h))
+                return self.layers[-1].pre_output(params[-1], h)
+
+            def loss_out(z):
+                return _losses.score(out_conf.loss, y,
+                                     _act.apply(out_conf.activation, z))
+
+            extra = {"damping": self.conf.damping_factor,
+                     "gauss_newton": (predict, loss_out)}
+        solver = Solver(out_conf, objective, listeners=self.listeners, **extra)
         result = solver.optimize(self.params, key)
         self.params = result.params
         self._score = result.score

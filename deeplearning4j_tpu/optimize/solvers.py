@@ -25,6 +25,7 @@ from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..nn.conf import NeuralNetConfiguration, OptimizationAlgorithm
 from ..utils import tree_math as tm
@@ -254,45 +255,94 @@ class StochasticHessianFree(BaseOptimizer):
 
     Capability match of ``StochasticHessianFree.java:27`` +
     ``MultiLayerNetwork``'s R-operator machinery (``:1415-1487``): solve
-    (H + λI) d = -g by CG, using Hessian-vector products from ``jax.jvp``
-    over ``jax.grad`` (no explicit H).  Levenberg-Marquardt style damping
-    adaptation via the reduction ratio (``dampingUpdate/reductionRatio``),
-    initial λ from ``MultiLayerConfiguration.damping_factor`` default 100.
+    (G + λI) d = -g by truncated CG, where G is the **Gauss-Newton** matrix
+    when the objective is supplied split as ``gauss_newton=(predict,
+    loss_out)`` — ``predict(params, key) -> z`` (the network up to the final
+    pre-activation) and ``loss_out(z) -> scalar`` (convex in z).  GN is PSD
+    on non-convex nets, which is exactly why the reference CGs on GN
+    products rather than the (indefinite) full Hessian; without the split we
+    fall back to true Hessian-vector products (jvp-over-grad), safe only
+    for convex-ish objectives.
+
+    The CG loop runs under ``lax.while_loop`` in ONE jitted program — no
+    per-iteration device->host sync (the r3 version ``float()``'d every CG
+    step).  Levenberg-Marquardt damping adaptation via the reduction ratio
+    (``dampingUpdate/reductionRatio``), initial λ from
+    ``MultiLayerConfiguration.damping_factor`` default 100.
     """
 
     name = "hessian_free"
     cg_iterations = 20
 
-    def __init__(self, *args, damping: float = 100.0, **kw):
+    def __init__(self, *args, damping: float = 100.0, gauss_newton=None, **kw):
         super().__init__(*args, **kw)
         self.damping = damping
+        self.gauss_newton = gauss_newton
+        self._jit_cg = None
+        self._jit_model = None
 
-    def _hvp(self, params, vec, key):
+    def _cvp(self, params, vec, key):
+        """Curvature-vector product: Gauss-Newton J^T H_L J v when the
+        split is available, else full Hessian-vector product."""
+        if self.gauss_newton is not None:
+            predict, loss_out = self.gauss_newton
+            z, jv = jax.jvp(lambda p: predict(p, key), (params,), (vec,))
+            _, hjv = jax.jvp(jax.grad(loss_out), (z,), (jv,))
+            _, vjp_fn = jax.vjp(lambda p: predict(p, key), params)
+            (gv,) = vjp_fn(hjv)
+            return gv
         grad_fn = lambda p: self.objective(p, key)[1]
         _, hv = jax.jvp(grad_fn, (params,), (vec,))
         return hv
 
-    def _cg_solve(self, params, grads, key):
-        """CG on (H + λI) x = -g, truncated."""
-        b = tm.neg(grads)
-        x = tm.zeros_like(b)
-        r = b
-        p = r
-        rs_old = float(tm.dot(r, r))
-        for _ in range(self.cg_iterations):
-            hp = tm.axpy(self.damping, p, self._hvp(params, p, key))
-            denom = float(tm.dot(p, hp))
-            if denom <= 1e-20:
-                break
-            alpha = rs_old / denom
-            x = tm.axpy(alpha, p, x)
-            r = tm.axpy(-alpha, hp, r)
-            rs_new = float(tm.dot(r, r))
-            if rs_new < 1e-10:
-                break
-            p = tm.axpy(rs_new / rs_old, p, r)
-            rs_old = rs_new
-        return x
+    def _cg_solve(self, params, grads, key, damping):
+        """Truncated CG on (G + λI) x = -g, compiled once: the whole loop is
+        a ``lax.while_loop`` with a pytree carry, so the only host sync is
+        the caller's use of the result."""
+        if self._jit_cg is None:
+            n_iters = self.cg_iterations
+
+            def cg(params, grads, key, lam):
+                b = tm.neg(grads)
+
+                def cond(carry):
+                    i, x, r, p, rs_old, live = carry
+                    return (i < n_iters) & live & (rs_old > 1e-10)
+
+                def body(carry):
+                    i, x, r, p, rs_old, live = carry
+                    hp = tm.axpy(lam, p, self._cvp(params, p, key))
+                    denom = tm.dot(p, hp)
+                    live = denom > 1e-20
+                    alpha = jnp.where(live,
+                                      rs_old / jnp.maximum(denom, 1e-20), 0.0)
+                    x = tm.axpy(alpha, p, x)
+                    r = tm.axpy(-alpha, hp, r)
+                    rs_new = jnp.where(live, tm.dot(r, r), 0.0)
+                    beta = rs_new / jnp.maximum(rs_old, 1e-30)
+                    p = tm.axpy(beta, p, r)
+                    return (i + 1, x, r, p, rs_new, live)
+
+                rs0 = tm.dot(b, b)
+                init = (jnp.zeros((), jnp.int32), tm.zeros_like(b), b, b,
+                        rs0, jnp.asarray(True))
+                _, x, _, _, _, _ = lax.while_loop(cond, body, init)
+                return x
+
+            self._jit_cg = jax.jit(cg)
+        return self._jit_cg(params, grads, key, jnp.asarray(damping, jnp.float32))
+
+    def _model_quantities(self, params, d, grads, key, damping):
+        """One jitted eval of (new_loss, damped quadratic-model reduction)."""
+        if self._jit_model is None:
+            def model(params, d, grads, key, lam):
+                new_loss = self.objective(tm.add(params, d), key)[0]
+                gd = tm.dot(grads, d)
+                dGd = tm.dot(d, tm.axpy(lam, d, self._cvp(params, d, key)))
+                return new_loss, gd + 0.5 * dGd
+            self._jit_model = jax.jit(model)
+        return self._jit_model(params, d, grads, key,
+                               jnp.asarray(damping, jnp.float32))
 
     def optimize(self, params, key=None) -> OptimizeResult:
         key = key if key is not None else jax.random.key(self.conf.seed)
@@ -305,20 +355,19 @@ class StochasticHessianFree(BaseOptimizer):
             loss, grads = self._jit_obj(params, sub)
             self._score = float(loss)
             history.append(self._score)
-            d = self._cg_solve(params, grads, sub)
+            d = self._cg_solve(params, grads, sub, self.damping)
             # quadratic-model reduction ratio → damping update (Martens §4.4;
             # reference dampingUpdate/reductionRatio)
-            new_params = tm.add(params, d)
-            new_loss = float(self.objective(new_params, sub)[0])
-            hd = self._hvp(params, d, sub)
-            quad = float(tm.dot(grads, d)) + 0.5 * float(tm.dot(d, hd))
+            new_loss_dev, quad_dev = self._model_quantities(
+                params, d, grads, sub, self.damping)
+            new_loss, quad = float(new_loss_dev), float(quad_dev)
             rho = (new_loss - self._score) / quad if quad != 0 else 0.0
             if rho > 0.75:
                 self.damping *= 2.0 / 3.0
             elif rho < 0.25:
                 self.damping *= 1.5
             if new_loss < self._score:
-                params = new_params
+                params = tm.add(params, d)
             for l in self.listeners:
                 l.iteration_done(self, it)
             if it > 0 and any(t.terminate(self._score, old_score, (grads,))

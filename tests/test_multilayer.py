@@ -71,6 +71,20 @@ def test_dbn_pretrain_then_finetune_iris():
     assert ev.f1() >= 0.8, ev.stats()
 
 
+def test_hessian_free_finetune_iris():
+    """HF fine-tune through the network path: the Gauss-Newton split (net up
+    to final pre-activation + convex loss-of-logits) trains a NON-convex
+    tanh-hidden MLP on Iris (VERDICT r3 #7 — the full-Hessian version was
+    only safe on convex-ish objectives)."""
+    ds = iris_data()
+    net = MultiLayerNetwork(mlp_conf(
+        n_iter=60, algo=OptimizationAlgorithm.HESSIAN_FREE))
+    net.init(jax.random.key(0))
+    net.fit(ds)
+    ev = net.evaluate(ds)
+    assert ev.f1() >= 0.9, ev.stats()
+
+
 def test_output_layer_alone_iris():
     """Softmax regression on Iris via CG (OutputLayerTest mirror)."""
     ds = iris_data()

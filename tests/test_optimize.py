@@ -127,6 +127,56 @@ def test_hessian_free_damping_adapts():
     assert hf.damping < 100.0  # good quadratic fit → damping shrinks
 
 
+def test_hessian_free_gauss_newton_converges_on_nonconvex_net():
+    """VERDICT r3 #7: HF on a small NON-convex net (tanh hidden layer) via
+    Gauss-Newton products.  The full Hessian is indefinite here — GN is PSD
+    by construction, so CG stays well-posed and HF actually trains the net."""
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((32, 2)), jnp.float32)
+    Y = jnp.tanh(X @ jnp.asarray([[1.5], [-2.0]])) * 0.7 + 0.1
+
+    params = {
+        "w1": jnp.asarray(rng.standard_normal((2, 8)) * 0.5, jnp.float32),
+        "b1": jnp.zeros((8,), jnp.float32),
+        "w2": jnp.asarray(rng.standard_normal((8, 1)) * 0.5, jnp.float32),
+        "b2": jnp.zeros((1,), jnp.float32),
+    }
+
+    def predict(p, key=None):
+        return jnp.tanh(X @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+    def loss_out(z):
+        return jnp.mean((z - Y) ** 2)
+
+    def objective(p, key):
+        return jax.value_and_grad(lambda q: loss_out(predict(q)))(p)
+
+    hf = StochasticHessianFree(
+        _conf(OptimizationAlgorithm.HESSIAN_FREE, iters=40), objective,
+        damping=1.0, gauss_newton=(predict, loss_out))
+    res = hf.optimize(params)
+    assert res.history[0] > 0.1, "net must start untrained"
+    assert res.score < 0.01, res.history[-5:]
+
+
+def test_hessian_free_cg_runs_without_host_sync_per_iter():
+    """The CG solve is one compiled call: its result is a device array and
+    repeated solves reuse the compiled while_loop (no growing jit cache)."""
+    obj = quadratic_objective(jnp.array([1.0, 2.0]))
+    hf = StochasticHessianFree(
+        _conf(OptimizationAlgorithm.HESSIAN_FREE, iters=2), obj, damping=0.1)
+    p = {"x": jnp.zeros(2)}
+    _, g = obj(p, None)
+    d1 = hf._cg_solve(p, g, jax.random.key(0), hf.damping)
+    assert isinstance(d1["x"], jax.Array)
+    cg_compiled = hf._jit_cg
+    hf._cg_solve(p, g, jax.random.key(1), hf.damping * 1.5)
+    assert hf._jit_cg is cg_compiled   # damping is a traced arg, not a retrace
+    # (H + λI)d = -g with H=I, λ=0.1: d = -g / 1.1
+    np.testing.assert_allclose(np.asarray(d1["x"]),
+                               -np.asarray(g["x"]) / 1.1, rtol=1e-5)
+
+
 def test_listener_and_termination():
     obj = quadratic_objective(jnp.array([1.0]))
     listener = ScoreIterationListener(print_every=1000)
