@@ -1,0 +1,137 @@
+"""Multi-PROCESS scaleout runtime: the master/worker loop of
+``scaleout.DistributedRunner`` with workers as real OS processes.
+
+This is the cluster-of-JVMs capability of the reference
+(``DeepLearning4jDistributed.java:128-187`` — master + worker nodes joined
+through Akka, shared state in Hazelcast, updates spilled to local files)
+mapped to the single-host-many-process shape: worker subprocesses share a
+:class:`~.procstate.FileStateTracker` directory, updates spill to disk
+(``LocalFileUpdateSaver`` parity), and a SIGKILL'd worker *process* is
+detected by heartbeat staleness, evicted, and its in-flight job re-routed —
+the real recovery chain, not a thread simulation.
+
+The performer travels as a ``"module:callable"`` spec string resolved by
+import in the worker process — the same reflection pattern the reference
+uses for ``WorkerPerformerFactory`` (``MasterActor.java:166-180``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+from .procstate import FileStateTracker
+from .scaleout import DistributedRunner, IterativeReduceWorkRouter
+
+
+def resolve_performer_factory(spec: str):
+    """``"pkg.module:attr"`` -> the factory callable."""
+    mod, _, attr = spec.partition(":")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def worker_loop(state_dir: str, worker_id: str, performer_spec: str,
+                heartbeat_s: float = 0.05, poll_s: float = 0.02) -> None:
+    """Worker-process main loop (``WorkerActor.java:150-160`` semantics:
+    heartbeat every tick, pull the assigned job, perform, push the update)."""
+    tracker = FileStateTracker(state_dir)
+    performer = resolve_performer_factory(performer_spec)(tracker)
+    # boot marker: interpreter startup can take seconds (site hooks import
+    # heavy deps), so the master must not start the eviction clock until
+    # the worker is actually alive — it waits for this file
+    (tracker.dir / "boot" / worker_id).touch()
+    while not tracker.is_done():
+        tracker.heartbeat(worker_id)
+        if not tracker.is_enabled(worker_id):
+            time.sleep(heartbeat_s)
+            continue
+        if tracker.needs_replicate(worker_id):
+            current = tracker.get_current()
+            if current is not None:
+                performer.update(current)
+            tracker.done_replicating(worker_id)
+        job = tracker.job_for(worker_id)
+        if job is None:
+            time.sleep(poll_s)
+            continue
+        performer.perform(job)
+        if job.result is not None:
+            tracker.add_update(worker_id, job.result)
+        tracker.clear_job(worker_id)
+
+
+class ProcessDistributedRunner(DistributedRunner):
+    """``DistributedRunner`` with OS-process workers over a shared
+    :class:`FileStateTracker` directory.
+
+    ``performer_spec`` replaces the in-process factory: a
+    ``"module:callable"`` string importable in the worker interpreter.
+    ``worker_env`` lets tests pin e.g. ``JAX_PLATFORMS=cpu``.
+    """
+
+    def __init__(self, job_iterator, performer_spec: str, state_dir: Path | str,
+                 n_workers: int = 2, router_cls=IterativeReduceWorkRouter,
+                 heartbeat_s: float = 0.05, poll_s: float = 0.02,
+                 eviction_timeout_s: float = 2.0,
+                 model_saver=None, worker_env: dict[str, str] | None = None):
+        tracker = FileStateTracker(state_dir)
+        super().__init__(job_iterator, performer_factory=None,
+                         n_workers=n_workers, router_cls=router_cls,
+                         tracker=tracker, model_saver=model_saver,
+                         heartbeat_s=heartbeat_s, poll_s=poll_s,
+                         eviction_timeout_s=eviction_timeout_s)
+        self.state_dir = str(state_dir)
+        self.performer_spec = performer_spec
+        self.worker_env = worker_env
+        self._procs: list[subprocess.Popen] = []
+
+    def worker_processes(self) -> list[subprocess.Popen]:
+        """Live Popen handles (tests use these to SIGKILL a worker)."""
+        return list(self._procs)
+
+    def _spawn_workers(self) -> None:
+        import os
+        env = dict(os.environ)
+        if self.worker_env:
+            env.update(self.worker_env)
+        # make the package importable in the worker regardless of master cwd
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        for i in range(self.n_workers):
+            wid = f"worker-{i}"
+            self.tracker.add_worker(wid)
+            log = open(Path(self.state_dir) / f"{wid}.log", "wb")
+            self._procs.append(subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu.parallel.worker_main",
+                 self.state_dir, wid, self.performer_spec,
+                 str(self.heartbeat_s), str(self.poll_s)],
+                env=env, stdout=log, stderr=subprocess.STDOUT))
+        # boot barrier: heartbeats (and thus eviction eligibility) only
+        # mean something once every worker process is actually up
+        deadline = time.time() + 120.0
+        boot = Path(self.state_dir) / "boot"
+        while time.time() < deadline:
+            if all((boot / f"worker-{i}").exists()
+                   for i in range(self.n_workers)):
+                break
+            time.sleep(0.05)
+        for i in range(self.n_workers):
+            self.tracker.heartbeat(f"worker-{i}")   # restart staleness clock
+
+    def _shutdown_workers(self) -> None:
+        self.tracker.finish()          # workers exit their loop on DONE
+        deadline = time.time() + 10.0
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def collect_result(state_dir: Path | str) -> Any:
+    """The final aggregated model from a finished run's state directory."""
+    return FileStateTracker(state_dir).get_current()

@@ -415,14 +415,24 @@ class DistributedRunner:
                 self.tracker.add_update(worker_id, job.result)
             self.tracker.clear_job(worker_id)
 
-    # -- master loop ----------------------------------------------------
-    def run(self, max_wall_s: float = 300.0) -> Any:
+    # -- worker lifecycle (subclass seam: ProcessDistributedRunner spawns
+    #    OS processes here instead of threads) ---------------------------
+    def _spawn_workers(self) -> None:
         for i in range(self.n_workers):
             wid = f"worker-{i}"
             self.tracker.add_worker(wid)
             t = threading.Thread(target=self._worker_loop, args=(wid,), daemon=True)
             self._threads.append(t)
             t.start()
+
+    def _shutdown_workers(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- master loop ----------------------------------------------------
+    def run(self, max_wall_s: float = 300.0) -> Any:
+        self._spawn_workers()
         deadline = time.time() + max_wall_s
         last_evict = time.time()
         requeue: list[Job] = []  # orphaned jobs from evicted workers
@@ -474,7 +484,5 @@ class DistributedRunner:
                     break
                 time.sleep(self.poll_s)
         finally:
-            self._stop.set()
-            for t in self._threads:
-                t.join(timeout=5.0)
+            self._shutdown_workers()
         return self.tracker.get_current()

@@ -1,0 +1,186 @@
+"""Real multi-PROCESS distributed execution (VERDICT r3 #4).
+
+Three escalating claims, none satisfiable by threads:
+
+1. ``initialize_multihost`` (the jax.distributed analog of the reference's
+   Akka seed join, ``DeepLearning4jDistributed.java:128-187``) actually
+   forms a 2-process JAX cluster on CPU, and a cross-process collective
+   returns the right value in BOTH processes.
+2. The scaleout SPI runs with OS-process workers over the file-backed
+   state plane (``LocalFileUpdateSaver.java:20`` parity): distributed
+   word count — the reference's hello-world performer — sums correctly.
+3. SIGKILL a worker *process* mid-run: heartbeats stop, the master evicts
+   it, re-routes the orphaned job, and the final model matches an
+   uninterrupted single-worker run exactly.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.performers import (
+    VectorDeltaPerformer, WordCountRouter)
+from deeplearning4j_tpu.parallel.procrunner import ProcessDistributedRunner
+from deeplearning4j_tpu.parallel.procstate import (
+    FileStateTracker, FileUpdateSaver, FileWorkRetriever)
+from deeplearning4j_tpu.parallel.scaleout import (
+    CollectionJobIterator, DistributedRunner, Job, StateTracker)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_MULTIHOST_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.parallel.mesh import initialize_multihost
+initialize_multihost()        # env-var driven, like the reference's conf keys
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+pid = jax.process_index()
+assert jax.process_count() == 2, jax.process_count()
+mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+local = np.full((4,), float(pid + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local)
+total = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(arr)
+print(f"RESULT proc={pid} total={float(total)}", flush=True)
+"""
+
+
+def test_initialize_multihost_two_processes():
+    """2 OS processes form a JAX cluster; a cross-process reduction agrees
+    in both.  Each process has 1 local CPU device holding full((4,), pid+1),
+    so the global sum is 4*1 + 4*2 = 12."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MULTIHOST_CHILD],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, f"stdout={out}\nstderr={err[-1500:]}"
+        outs.append(out)
+    for pid, out in enumerate(outs):
+        assert f"RESULT proc={pid} total=12.0" in out, out
+
+
+def test_process_runner_word_count(tmp_path):
+    """The reference's distributed word-count example on OS-process workers."""
+    lines = ["the quick brown fox", "the lazy dog", "the fox jumps",
+             "over the lazy dog", "quick quick brown"]
+    runner = ProcessDistributedRunner(
+        CollectionJobIterator(lines),
+        "deeplearning4j_tpu.parallel.performers:WordCountPerformer",
+        state_dir=tmp_path / "state", n_workers=2,
+        router_cls=WordCountRouter,
+        worker_env={"JAX_PLATFORMS": "cpu"})
+    result = runner.run(max_wall_s=60.0)
+    from collections import Counter
+    want = Counter(" ".join(lines).split())
+    assert result == want
+    # updates really spilled through the file plane
+    assert (tmp_path / "state" / "updates").is_dir()
+
+
+def test_file_state_plane_roundtrips(tmp_path):
+    """FileUpdateSaver / FileWorkRetriever / FileStateTracker behave like
+    their in-memory counterparts across reopens (restart survival)."""
+    saver = FileUpdateSaver(tmp_path / "u")
+    saver.save("w0", {"a": np.arange(3)})
+    reloaded = FileUpdateSaver(tmp_path / "u").load("w0")
+    np.testing.assert_array_equal(reloaded["a"], np.arange(3))
+
+    retr = FileWorkRetriever(tmp_path / "s")
+    retr.save("w0", Job(work=7.0, worker_id="w0"))
+    assert FileWorkRetriever(tmp_path / "s").load("w0").work == 7.0
+
+    t = FileStateTracker(tmp_path / "t")
+    t.add_worker("w0")
+    t.set_current(np.ones(2))
+    t.add_job(Job(work=1.0, worker_id="w0"))
+    t2 = FileStateTracker(tmp_path / "t")      # a different "process"
+    assert t2.workers() == ["w0"]
+    assert t2.needs_replicate("w0")
+    np.testing.assert_array_equal(t2.get_current(), np.ones(2))
+    assert t2.job_for("w0").work == 1.0
+    t2.clear_job("w0")
+    assert t.job_for("w0") is None
+    assert t.load_for_worker("w0").work == 1.0  # WorkRetriever persistence
+
+
+def _reference_run(jobs):
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(VectorDeltaPerformer.dim))
+    runner = DistributedRunner(
+        CollectionJobIterator(jobs), VectorDeltaPerformer, n_workers=1,
+        tracker=tracker)
+    return np.asarray(runner.run(max_wall_s=60.0))
+
+
+def test_sigkill_worker_process_recovery_parity(tmp_path):
+    """Kill a worker with SIGKILL mid-run; the master evicts it by
+    heartbeat staleness, re-routes the orphan from the file plane, and the
+    final model matches the uninterrupted single-worker run."""
+    jobs = [1.0, 2.0, 3.0, 4.0, 5.0]
+    ref = _reference_run(jobs)
+
+    state = tmp_path / "state"
+    runner = ProcessDistributedRunner(
+        CollectionJobIterator(jobs),
+        "deeplearning4j_tpu.parallel.performers:SlowVectorDeltaPerformer",
+        state_dir=state, n_workers=2, eviction_timeout_s=1.0,
+        worker_env={"JAX_PLATFORMS": "cpu"})
+    runner.tracker.set_current(np.zeros(VectorDeltaPerformer.dim))
+
+    killed = {}
+
+    import threading
+
+    def assassin():
+        # wait until worker-0 has a job in flight, then SIGKILL its process
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if (state / "jobs" / "worker-0").exists() and runner.worker_processes():
+                proc = runner.worker_processes()[0]
+                os.kill(proc.pid, signal.SIGKILL)
+                killed["pid"] = proc.pid
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=assassin, daemon=True)
+    t.start()
+    result = runner.run(max_wall_s=90.0)
+    t.join(timeout=5.0)
+
+    assert "pid" in killed, "assassin never fired"
+    assert "worker-0" not in runner.tracker.workers()   # evicted
+    assert runner.tracker.is_done()
+    np.testing.assert_allclose(np.asarray(result), ref, atol=1e-12)
