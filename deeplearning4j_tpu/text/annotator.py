@@ -306,6 +306,7 @@ class AveragedPerceptronTagger:
                 j = self.classes.index(fixed)
                 probs[i] = (1.0 - 0.95) / max(1, len(self.classes) - 1)
                 probs[i, j] = 0.95
+                probs[i] /= probs[i].sum()   # exact with 1 class, no-op else
             else:
                 feats = self._features(i + 2, tok, context, prev, prev2)
                 scores = self._score(feats)

@@ -196,8 +196,12 @@ class TreebankParser:
         return tree
 
     # ------------------------------------------------------------------ internals
-    def _unary_closure(self, cell, max_iters: int = 3):
-        for _ in range(max_iters):
+    def _unary_closure(self, cell):
+        # iterate to fixpoint: updates strictly increase a cell entry's
+        # log-prob and rule log-probs are <= 0, so termination is guaranteed
+        # (a capped loop would silently truncate unary chains longer than
+        # the cap in induced grammars)
+        while True:
             changed = False
             for b, (lp_b, _) in list(cell.items()):
                 for a, lp_rule in self.grammar.unary.get(b, ()):

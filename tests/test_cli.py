@@ -1,0 +1,44 @@
+"""CLI smoke tests (VERDICT r3 #8) — ``python -m deeplearning4j_tpu``, the
+``DeepLearning4jDistributedApp.main`` analog."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(*argv, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", *argv],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_cli_train_evaluate_roundtrip(tmp_path):
+    model = tmp_path / "iris.model"
+    p = _run("train", "--dataset", "iris", "--iterations", "120",
+             "--out", str(model))
+    assert p.returncode == 0, p.stderr[-1500:]
+    assert "f1" in p.stdout.lower() or "accuracy" in p.stdout.lower(), p.stdout
+    assert model.exists()
+
+    p = _run("evaluate", str(model), "--dataset", "iris")
+    assert p.returncode == 0, p.stderr[-1500:]
+    assert "accuracy" in p.stdout.lower() or "f1" in p.stdout.lower()
+
+
+def test_cli_scaleout_word_count(tmp_path):
+    jobs = tmp_path / "jobs.txt"
+    jobs.write_text("a b a\nb c\n")
+    p = _run("scaleout", "--state-dir", str(tmp_path / "state"),
+             "--jobs", str(jobs), "--workers", "2")
+    assert p.returncode == 0, p.stderr[-1500:]
+    assert "'a': 2" in p.stdout or '"a": 2' in p.stdout, p.stdout
+
+
+def test_cli_usage_error():
+    p = _run("train", "--dataset", "nope")
+    assert p.returncode != 0
