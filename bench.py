@@ -201,20 +201,26 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         TransformerConfig, TransformerLM)
     from deeplearning4j_tpu.optimize import transforms as T
 
+    # BENCH_ATTENTION=flash opts the TPU legs into the Pallas flash kernel
+    # (ops/flash_attention.py); default stays the XLA ring/block path until
+    # a real-chip run validates the kernel end-to-end.
+    attention = os.environ.get("BENCH_ATTENTION", "ring")
     if on_tpu and conserve_hbm:
         # OOM retry path: remat + half batch (main() falls back here when
         # the full-size leg dies with RESOURCE_EXHAUSTED)
         batch, seq, iters = 32, 512, 16
         cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
                                 n_layers=12, d_ff=3072, max_len=seq,
-                                causal=False, dtype=jnp.bfloat16, remat=True)
+                                causal=False, dtype=jnp.bfloat16, remat=True,
+                                attention=attention)
     elif on_tpu:
         # remat off: BERT-base at this batch fits v5e HBM comfortably and
         # remat's recompute would burn ~1/3 more FLOPs for nothing.
         batch, seq, iters = 64, 512, 16
         cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
                                 n_layers=12, d_ff=3072, max_len=seq,
-                                causal=False, dtype=jnp.bfloat16, remat=False)
+                                causal=False, dtype=jnp.bfloat16, remat=False,
+                                attention=attention)
     else:
         batch, seq, iters = 4, 128, 4
         cfg = TransformerConfig(vocab_size=1024, d_model=128, n_heads=4,
@@ -259,6 +265,7 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
     e2e = _stats(e2e_times)
     return {
         "name": "bert_base", "iters": iters, "batch": batch, "seq": seq,
+        "attention": cfg.attention,
         "iter_times": iter_times, "stats": st,
         "e2e_stats": e2e,
         "tokens_per_sec": batch * seq / st["median_s"],
@@ -550,6 +557,7 @@ def main():
         **({"hbm_fallback": bert["hbm_fallback"]}
            if "hbm_fallback" in bert else {}),
         "batch_seq": [bert["batch"], bert["seq"]],
+        "attention": bert["attention"],
         "flops_per_token": round(bert["flops_per_token_analytic"]),
         **({"flops_analytic_over_xla": bert["flops_analytic_over_xla"]}
            if "flops_analytic_over_xla" in bert else {}),

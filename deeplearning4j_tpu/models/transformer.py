@@ -58,6 +58,9 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16        # MXU compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True               # jax.checkpoint each block (HBM for FLOPs)
+    attention: str = "ring"          # "ring" (default) | "flash" (Pallas
+    #                                  kernel, single-shard only; opt-in
+    #                                  until benchmarked on a real chip)
 
     @property
     def head_dim(self) -> int:
@@ -259,8 +262,12 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
         h = copy_to_tp(h, tp_axis)
     qkv = jnp.einsum("btd,dshe->btshe", h.astype(dt), params["wqkv"].astype(dt))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = ring_attention(q, k, v, n_sp=n_sp, sp_axis=sp_axis,
-                          causal=cfg.causal, t_local=t_local)
+    if cfg.attention == "flash" and n_sp == 1 and t_local % 128 == 0:
+        from ..ops.flash_attention import flash_attention
+        attn = flash_attention(q, k, v, causal=cfg.causal)
+    else:
+        attn = ring_attention(q, k, v, n_sp=n_sp, sp_axis=sp_axis,
+                              causal=cfg.causal, t_local=t_local)
     proj = jnp.einsum("bthe,hed->btd", attn.astype(dt), params["wo"].astype(dt))
     if tp_axis:
         proj = reduce_from_tp(proj, tp_axis)  # partial sums over local heads

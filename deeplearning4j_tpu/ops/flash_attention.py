@@ -1,0 +1,188 @@
+"""Fused flash attention as a Pallas TPU kernel.
+
+Perf groundwork for the flagship transformer (BASELINE.md: >=35% MFU on
+BERT-base): the XLA path materializes per-layer (B, H, T, T) score tensors
+in HBM; this kernel keeps the running-softmax state in VMEM and streams
+K/V blocks through the MXU, so attention becomes HBM-bandwidth-light and
+O(T) in memory.  Single-(shard-)chip op: under sequence parallelism the
+ring layer (``models/transformer.ring_attention``) still rotates K/V
+between chips and can call any per-block attention underneath.
+
+Design (standard flash attention v2 schedule):
+- grid = (batch*heads, T/BQ); each program owns one query block and loops
+  over key blocks with a ``fori_loop``, carrying (acc, m, l) in registers.
+- causal masking compares block-level iota offsets, so fully-masked key
+  blocks still stream but contribute zeros (simple, branch-free).
+- the kernel also emits the row log-sum-exp, and a ``jax.custom_vjp``
+  backward recomputes per-block probabilities from (q, k, v, lse) under a
+  ``lax.scan`` over key blocks — O(T) memory in the backward too, no
+  hand-written backward kernel to maintain.
+
+The op runs in Pallas interpret mode automatically on CPU (tests), and as
+a compiled Mosaic kernel on TPU.  It is OPT-IN via
+``TransformerConfig(attention="flash")`` until a real-chip benchmark
+validates it end-to-end.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable on CPU-only jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
+                block_k: int, seq_len: int, scale: float):
+    q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+    bq = q.shape[0]
+    qi = pl.program_id(1)
+    q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    n_k = seq_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            k_pos = (j * block_k
+                     + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, n_k, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    """q/k/v: (BH, T, D) -> (out (BH, T, D), lse (BH, T))."""
+    bh, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (t, block_q, block_k)
+    scale = d ** -0.5
+
+    kernel = functools.partial(_fwd_kernel, causal=causal, block_k=block_k,
+                               seq_len=t, scale=scale)
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **mem),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+def _blockwise_bwd(q, k, v, out, lse, do, causal, block_k):
+    """O(T)-memory backward: rebuild P per key block from (q, lse) under a
+    scan, accumulate dq and emit per-block dk/dv (flash attention v2
+    backward math, plain JAX so autodiff/XLA handle fusion)."""
+    bh, t, d = q.shape
+    block_k = min(block_k, t)
+    n_k = t // block_k
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    dof = do.astype(jnp.float32)
+    # D_i = rowsum(dO * O) (the lse-gradient shortcut)
+    delta = (dof * out.astype(jnp.float32)).sum(-1)            # (BH, T)
+    q_pos = jnp.arange(t)[:, None]
+
+    kb = k.reshape(bh, n_k, block_k, d).swapaxes(0, 1).astype(jnp.float32)
+    vb = v.reshape(bh, n_k, block_k, d).swapaxes(0, 1).astype(jnp.float32)
+
+    def body(dq_acc, blk):
+        j, k_j, v_j = blk                                       # (BH, BK, D)
+        s = jnp.einsum("btd,bkd->btk", qf, k_j)                 # (BH, T, BK)
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                         # (BH, T, BK)
+        dv_j = jnp.einsum("btk,btd->bkd", p, dof)
+        dp = jnp.einsum("btd,bkd->btk", dof, v_j)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, k_j) * scale
+        dk_j = jnp.einsum("btk,btd->bkd", ds, qf)
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((bh, t, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        body, dq0, (jnp.arange(n_k), kb, vb))
+    dk = dk_blocks.swapaxes(0, 1).reshape(bh, t, d)
+    dv = dv_blocks.swapaxes(0, 1).reshape(bh, t, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhtd(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_bhtd_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhtd_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _blockwise_bwd(q, k, v, out, lse, do, causal, block_k)
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bhtd_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Fused attention for (B, T, H, D) tensors (the transformer's layout).
+
+    ``interpret=None`` auto-selects Pallas interpret mode off-TPU so the
+    same call works in CPU tests and compiles to Mosaic on the chip.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t, h, d = q.shape
+
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
+                      causal, block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
