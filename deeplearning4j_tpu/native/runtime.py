@@ -22,9 +22,12 @@ def lib() -> ctypes.CDLL | None:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    if not _LIB_PATH.exists():
+    src = Path(__file__).parent / "src" / "host_runtime.cpp"
+    stale = (_LIB_PATH.exists() and src.exists()
+             and src.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+    if not _LIB_PATH.exists() or stale:
         from .build import build
-        if build(verbose=False) is None:
+        if build(verbose=False) is None and not _LIB_PATH.exists():
             return None
     try:
         l = ctypes.CDLL(str(_LIB_PATH))
@@ -44,6 +47,11 @@ def lib() -> ctypes.CDLL | None:
     l.drt_parse_csv_floats.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+    if hasattr(l, "drt_cooccurrence"):   # absent in a stale pre-built .so
+        l.drt_cooccurrence.restype = ctypes.c_void_p
+        l.drt_cooccurrence.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
     _LIB = l
     return _LIB
 
@@ -108,6 +116,34 @@ def skipgram_pairs(sentence_indices, window: int, seed: int):
     if wrote != n:
         return None
     return centers, contexts
+
+
+def cooccurrence(sentence_indices, window: int):
+    """Native window-weighted co-occurrence accumulation (the GloVe host
+    hot loop).  Returns (rows, cols, vals) arrays or None -> Python path."""
+    l = lib()
+    if l is None or not hasattr(l, "drt_cooccurrence") or not sentence_indices:
+        return None
+    tokens = np.concatenate(sentence_indices).astype(np.int32)
+    offsets = np.zeros(len(sentence_indices) + 1, np.int64)
+    np.cumsum([len(s) for s in sentence_indices], out=offsets[1:])
+    out_bytes = ctypes.c_int64(0)
+    ptr = l.drt_cooccurrence(
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(sentence_indices), window, ctypes.byref(out_bytes))
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr, out_bytes.value)
+    finally:
+        l.drt_free(ptr)
+    n = int(np.frombuffer(raw[:8], np.int64)[0])
+    rec = np.frombuffer(raw[8:], np.uint8).reshape(n, 12)
+    rows = rec[:, 0:4].copy().view(np.int32)[:, 0]
+    cols = rec[:, 4:8].copy().view(np.int32)[:, 0]
+    vals = rec[:, 8:12].copy().view(np.float32)[:, 0]
+    return rows, cols, vals
 
 
 def parse_csv_floats(text: str, n_cols: int) -> np.ndarray | None:

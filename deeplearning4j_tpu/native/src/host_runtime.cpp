@@ -102,6 +102,48 @@ int64_t drt_skipgram_pairs(const int32_t* tokens, const int64_t* offsets,
     return n;
 }
 
+// ---------------------------------------------------------------- glove
+// Window-weighted co-occurrence accumulation (GloVe's host-side hot loop:
+// increment by 1/distance within the forward window, symmetrized).
+// Returns a malloc'd packed buffer: int64 n, then n records of
+// (int32 row, int32 col, float val).  Caller frees via drt_free.
+char* drt_cooccurrence(const int32_t* tokens, const int64_t* offsets,
+                       int64_t n_sentences, int32_t window,
+                       int64_t* out_bytes) {
+    std::unordered_map<int64_t, float> counts;
+    for (int64_t s = 0; s < n_sentences; ++s) {
+        int64_t lo = offsets[s], hi = offsets[s + 1];
+        int64_t len = hi - lo;
+        for (int64_t pos = 0; pos < len; ++pos) {
+            int64_t jmax = pos + window + 1 < len ? pos + window + 1 : len;
+            int32_t wi = tokens[lo + pos];
+            for (int64_t j = pos + 1; j < jmax; ++j) {
+                float inc = 1.0f / static_cast<float>(j - pos);
+                int32_t wj = tokens[lo + j];
+                counts[(static_cast<int64_t>(wi) << 32) |
+                       static_cast<uint32_t>(wj)] += inc;
+                counts[(static_cast<int64_t>(wj) << 32) |
+                       static_cast<uint32_t>(wi)] += inc;
+            }
+        }
+    }
+    int64_t n = static_cast<int64_t>(counts.size());
+    int64_t bytes = 8 + n * 12;
+    char* buf = static_cast<char*>(std::malloc(bytes));
+    std::memcpy(buf, &n, 8);
+    char* p = buf + 8;
+    for (const auto& kv : counts) {
+        int32_t row = static_cast<int32_t>(kv.first >> 32);
+        int32_t col = static_cast<int32_t>(kv.first & 0xFFFFFFFF);
+        std::memcpy(p, &row, 4);
+        std::memcpy(p + 4, &col, 4);
+        std::memcpy(p + 8, &kv.second, 4);
+        p += 12;
+    }
+    *out_bytes = bytes;
+    return buf;
+}
+
 // ---------------------------------------------------------------- csv
 // Parse a float CSV buffer into a dense row-major array. Returns rows
 // written, or -1 on ragged rows. out must hold max_rows*n_cols floats.

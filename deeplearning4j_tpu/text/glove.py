@@ -52,12 +52,26 @@ class CoOccurrences:
         self.tokenizer_factory = tokenizer_factory
         self.window = window
         self.counts: dict[tuple[int, int], float] = defaultdict(float)
+        self._arrays = None          # native-path result (rows, cols, vals)
 
     def fit(self, sentences: Iterable[str]) -> "CoOccurrences":
+        sent_idx = []
         for s in sentences:
             toks = self.tokenizer_factory.create(s).get_tokens()
             idx = [self.vocab.index_of(t) for t in toks]
-            idx = [i for i in idx if i >= 0]
+            sent_idx.append([i for i in idx if i >= 0])
+        # native fast path (the GloVe host hot loop, like word2vec's
+        # skip-gram generation); exact Python fallback below
+        try:
+            from ..native import runtime as native_rt
+            native = native_rt.cooccurrence(
+                [np.asarray(s, np.int32) for s in sent_idx if s], self.window)
+        except ImportError:
+            native = None
+        if native is not None:
+            self._arrays = native
+            return self
+        for idx in sent_idx:
             for pos, wi in enumerate(idx):
                 for off in range(1, self.window + 1):
                     j = pos + off
@@ -69,6 +83,8 @@ class CoOccurrences:
         return self
 
     def arrays(self):
+        if getattr(self, "_arrays", None) is not None:
+            return self._arrays
         items = list(self.counts.items())
         rows = np.array([ij[0] for ij, _ in items], np.int32)
         cols = np.array([ij[1] for ij, _ in items], np.int32)

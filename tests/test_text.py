@@ -202,3 +202,36 @@ def test_native_skipgram_pairs_match_python_counts():
     first = set(range(5))
     for c, x in zip(centers.tolist(), contexts.tolist()):
         assert (c in first) == (x in first)
+
+
+def test_native_cooccurrence_matches_python():
+    """The C++ co-occurrence accumulator computes exactly the Python
+    fallback's window-weighted counts (skipped when the native lib is
+    unavailable)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.native import runtime as native_rt
+
+    sent_idx = [np.array([0, 1, 2, 1, 3], np.int32),
+                np.array([2, 2, 0], np.int32)]
+    native = native_rt.cooccurrence(sent_idx, window=2)
+    if native is None:
+        _pytest.skip("native host runtime not built")
+    rows, cols, vals = native
+
+    from collections import defaultdict
+    want = defaultdict(float)
+    for idx in sent_idx:
+        for pos, wi in enumerate(idx):
+            for off in range(1, 3):
+                j = pos + off
+                if j >= len(idx):
+                    break
+                want[(int(wi), int(idx[j]))] += 1.0 / off
+                want[(int(idx[j]), int(wi))] += 1.0 / off
+
+    got = {(int(r), int(c)): float(v) for r, c, v in zip(rows, cols, vals)}
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-6, (k, got[k], want[k])
