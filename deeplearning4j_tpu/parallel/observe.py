@@ -147,7 +147,9 @@ class StatusServer:
                                  for w in t.workers()},
             "current_jobs": len(t.current_jobs()),
             "pending_updates": sorted(t.updates().keys()),
-            "counters": dict(t._counters),
+            # in-memory tracker exposes its counter dict; the file-backed
+            # tracker has no cheap enumerate — omit rather than scan disk
+            "counters": dict(getattr(t, "_counters", {})),
             "done": t.is_done(),
         }
 

@@ -1,7 +1,9 @@
 """L4 — visualization (reference: ``plot/``)."""
 
 from .tsne import BarnesHutTsne, Tsne
+from .render_app import EmbeddingRenderServer, render_word_vectors
 from .renderers import FilterRenderer, NeuralNetPlotter, draw_mnist_grid
 
-__all__ = ["BarnesHutTsne", "Tsne", "FilterRenderer", "NeuralNetPlotter",
+__all__ = ["BarnesHutTsne", "Tsne", "EmbeddingRenderServer",
+           "render_word_vectors", "FilterRenderer", "NeuralNetPlotter",
            "draw_mnist_grid"]

@@ -100,3 +100,57 @@ def test_renderers(tmp_path):
     assert p.exists()
     p2 = draw_mnist_grid(rng.random((12, 64)), tmp_path / "g.png")
     assert p2.exists()
+
+
+# --------------------------------------------------------------------------
+# Interactive embedding render app (RenderApplication.java parity)
+# --------------------------------------------------------------------------
+
+def test_embedding_render_server_serves_page_and_coords():
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.plot import EmbeddingRenderServer
+
+    words = ["alpha", "beta", "gamma"]
+    coords = np.array([[0.0, 0.0], [1.0, 2.0], [-1.0, 0.5]])
+    srv = EmbeddingRenderServer(words, coords).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        page = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "canvas" in page and "api/coords" in page
+        data = json.loads(urllib.request.urlopen(
+            base + "/api/coords", timeout=10).read())
+        assert [d["word"] for d in data] == words
+        assert data[1] == {"word": "beta", "x": 1.0, "y": 2.0}
+        # live update republished on next poll
+        srv.update(words, coords + 1.0)
+        data2 = json.loads(urllib.request.urlopen(
+            base + "/api/coords", timeout=10).read())
+        assert data2[0]["x"] == 1.0
+        # bad shape rejected
+        with pytest.raises(ValueError):
+            srv.update(words, np.zeros((2, 2)))
+    finally:
+        srv.stop()
+
+
+def test_render_word_vectors_from_word2vec():
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.plot.render_app import render_word_vectors
+    from deeplearning4j_tpu.text.word2vec import Word2Vec
+
+    corpus = ["the cat sat on the mat", "the dog sat on the rug",
+              "cats and dogs play"] * 10
+    w2v = Word2Vec(corpus, layer_size=16, min_word_frequency=1, iterations=2, seed=0)
+    w2v.fit()
+    srv = render_word_vectors(w2v, max_words=10, n_iter=50)
+    try:
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/api/coords", timeout=10).read())
+        assert 1 < len(data) <= 10
+        assert all(np.isfinite([d["x"], d["y"]]).all() for d in data)
+    finally:
+        srv.stop()
