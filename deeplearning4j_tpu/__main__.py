@@ -85,6 +85,13 @@ def _cmd_evaluate(args) -> int:
 
 
 def _cmd_scaleout(args) -> int:
+    if args.type == "kill":
+        # the YARN Kill CLI analog: raise the DONE flag so the master loop
+        # and every worker process wind down at their next poll
+        from .parallel.procstate import FileStateTracker
+        FileStateTracker(args.state_dir).finish()
+        print(f"kill signalled in {args.state_dir}")
+        return 0
     if args.type == "worker":
         from .parallel.procrunner import worker_loop
         worker_loop(args.state_dir, args.worker_id, args.performer)
@@ -92,6 +99,8 @@ def _cmd_scaleout(args) -> int:
     from .parallel.performers import WordCountRouter
     from .parallel.procrunner import ProcessDistributedRunner
     from .parallel.scaleout import CollectionJobIterator
+    if not args.jobs:
+        raise SystemExit("--jobs is required for the master role")
     lines = [ln for ln in Path(args.jobs).read_text().splitlines() if ln.strip()]
     router = (WordCountRouter if args.router == "wordcount" else None)
     kw = {"router_cls": router} if router else {}
@@ -104,13 +113,22 @@ def _cmd_scaleout(args) -> int:
     return 0
 
 
+def _cmd_provision(args) -> int:
+    from .parallel.provision import PodSliceProvisioner, PodSliceSpec
+    prov = PodSliceProvisioner(PodSliceSpec(
+        name=args.name, accelerator_type=args.accelerator_type,
+        zone=args.zone, spot=args.spot))
+    if args.out:
+        path = prov.write_script(args.out, args.repo_url, args.train_argv)
+        print(f"wrote {path}")
+    else:
+        print(prov.render_script(args.repo_url, args.train_argv))
+    return 0
+
+
 def _cmd_dryrun(args) -> int:
-    import importlib.util
-    path = Path(__file__).resolve().parents[1] / "__graft_entry__.py"
-    spec = importlib.util.spec_from_file_location("graft_entry", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    mod.dryrun_multichip(args.devices)
+    from .parallel.dryrun import dryrun_multichip
+    dryrun_multichip(args.devices)
     return 0
 
 
@@ -136,7 +154,7 @@ def main(argv=None) -> int:
     e.set_defaults(fn=_cmd_evaluate)
 
     s = sub.add_parser("scaleout", help="multi-process scaleout runtime")
-    s.add_argument("-t", "--type", choices=("master", "worker"),
+    s.add_argument("-t", "--type", choices=("master", "worker", "kill"),
                    default="master")
     s.add_argument("--state-dir", required=True)
     s.add_argument("--performer",
@@ -151,6 +169,17 @@ def main(argv=None) -> int:
     d = sub.add_parser("dryrun", help="multi-chip sharding dryrun")
     d.add_argument("--devices", type=int, default=8)
     d.set_defaults(fn=_cmd_dryrun)
+
+    p = sub.add_parser("provision",
+                       help="render a pod-slice create/bootstrap/launch script")
+    p.add_argument("--name", default="dl4j-tpu-slice")
+    p.add_argument("--accelerator-type", default="v5litepod-64")
+    p.add_argument("--zone", default="us-west4-a")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--repo-url", required=True)
+    p.add_argument("--train-argv", default="-m deeplearning4j_tpu train")
+    p.add_argument("--out", help="write the script here instead of stdout")
+    p.set_defaults(fn=_cmd_provision)
 
     ap.add_argument("--platform", default="cpu",
                     help="jax platform (default cpu; pass 'tpu'/'' to use "

@@ -1,0 +1,81 @@
+"""Multi-chip sharding dryrun: one full explicit-SPMD train step on tiny
+shapes over an n-device mesh (dp/pp/tp/sp — GPipe micro-batch pipeline over
+pp, Megatron-style tp, ring attention over sp, dp gradient pmean).
+
+Package home of the logic behind the repo-root ``__graft_entry__.py``
+driver hook and the ``python -m deeplearning4j_tpu dryrun`` CLI: both
+import from here, so the check works from an installed package too.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mesh_spec_for(n_devices: int):
+    """Factor n into (dp, pp, tp, sp): peel 2s round-robin so every
+    parallelism kind is exercised when n allows (8 -> dp2·pp2·tp2,
+    16 -> + sp2).  ep is exercised by the sharded-embedding path
+    (tests/test_sharded_embedding.py) rather than the flagship step."""
+    from .mesh import MeshSpec
+    dims = {"dp": 1, "pp": 1, "tp": 1, "sp": 1}
+    order = ["dp", "pp", "tp", "sp"]
+    n, i = n_devices, 0
+    while n % 2 == 0 and n > 1:
+        dims[order[i % 4]] *= 2
+        n //= 2
+        i += 1
+    dims["dp"] *= n  # odd residue onto dp
+    return MeshSpec(dp=dims["dp"], sp=dims["sp"], tp=dims["tp"],
+                    pp=dims["pp"], ep=1)
+
+
+def dryrun_multichip(n_devices: int) -> None:
+    """One full sharded train step on tiny shapes over n virtual devices.
+
+    Forces the CPU platform in-process: the environment's boot-time TPU
+    registration overrides JAX_PLATFORMS env vars, and this check must run
+    on the virtual CPU device pool.
+    """
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())} "
+        "(set XLA_FLAGS=--xla_force_host_platform_device_count)")
+
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..optimize import transforms as T
+    from .mesh import make_mesh
+
+    spec = mesh_spec_for(n_devices)
+    mesh = make_mesh(spec, devices=jax.devices()[:n_devices])
+
+    sizes = spec.resolve(n_devices)
+    n_heads = max(4, sizes["tp"] * 2)
+    seq = 8 * sizes["sp"]
+    n_micro = 2 * sizes["pp"]
+    batch = sizes["dp"] * n_micro      # local batch per dp shard == n_micro
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=8 * n_heads, n_heads=n_heads,
+        n_layers=2 * sizes["pp"], d_ff=64, max_len=seq, causal=True,
+        dtype=jnp.float32, remat=True,
+    )
+
+    if sizes["pp"] > 1:
+        from ..models.pipeline import PipelinedTransformerLM
+        model = PipelinedTransformerLM(cfg, mesh, n_micro=n_micro)
+    else:
+        model = TransformerLM(cfg, mesh=mesh)
+    tx = T.adamw(T.warmup_cosine(1e-2, 2, 100), weight_decay=0.01)
+    params = model.place(model.init(jax.random.key(0)))
+    opt = model.init_opt(params, tx)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    step = model.build_train_step(tx)
+    params, _, loss = step(params, opt, tokens, targets)
+    loss = float(loss)
+    assert jnp.isfinite(loss), f"non-finite loss {loss}"
+    print(f"dryrun_multichip OK: mesh={dict(sizes)} devices={n_devices} "
+          f"batch={batch} seq={seq} n_micro={n_micro if sizes['pp'] > 1 else 0} "
+          f"loss={loss:.4f}")

@@ -226,5 +226,8 @@ class FileStateTracker:
     def finish(self) -> None:
         (self.dir / "DONE").touch()
 
+    def reset_done(self) -> None:
+        (self.dir / "DONE").unlink(missing_ok=True)
+
     def is_done(self) -> bool:
         return (self.dir / "DONE").exists()

@@ -1,0 +1,159 @@
+"""Cluster provisioning — the TPU-native analog of the reference's AWS
+module (``deeplearning4j-aws``): ``Ec2BoxCreator.java:19,59`` (create spot/
+on-demand instances), ``provision/ClusterSetup.java:24`` +
+``HostProvisioner`` (SSH fan-out setup), and the YARN ``Client`` launch
+path.
+
+There is no cloud reachable from this environment, so the module does what
+those classes actually owe the framework: given a cluster spec, produce the
+exact commands/scripts that create a TPU pod slice and bring the training
+job up on every host — creation command, per-host bootstrap, and a
+coordinated multi-host launch with the ``jax.distributed`` env contract
+(``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``) that
+``parallel.mesh.initialize_multihost`` consumes.  Everything is returned as
+data (and optionally written as a shell script) so it is testable offline
+and runnable verbatim where a cloud is present.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+from pathlib import Path
+
+__all__ = ["PodSliceSpec", "PodSliceProvisioner"]
+
+# chips per host is fixed per accelerator generation (v5e: 4-chip hosts)
+_CHIPS_PER_HOST = {"v5litepod": 4, "v5p": 4, "v4": 4, "v3": 8, "v2": 8}
+
+
+@dataclasses.dataclass(frozen=True)
+class PodSliceSpec:
+    """What ``Ec2BoxCreator``'s (ami, size, numBoxes) tuple becomes on TPU:
+    a named slice of an accelerator type in a zone."""
+
+    name: str = "dl4j-tpu-slice"
+    accelerator_type: str = "v5litepod-64"   # BASELINE.md scaling target
+    zone: str = "us-west4-a"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    project: str | None = None
+    spot: bool = False                        # Ec2BoxCreator spot parity
+    coordinator_port: int = 8476
+
+    @property
+    def generation(self) -> str:
+        return self.accelerator_type.rsplit("-", 1)[0]
+
+    @property
+    def n_chips(self) -> int:
+        return int(self.accelerator_type.rsplit("-", 1)[1])
+
+    @property
+    def n_hosts(self) -> int:
+        per = _CHIPS_PER_HOST.get(self.generation, 4)
+        return max(1, self.n_chips // per)
+
+
+class PodSliceProvisioner:
+    """Renders the create/bootstrap/launch command set for a pod slice."""
+
+    def __init__(self, spec: PodSliceSpec):
+        self.spec = spec
+
+    # -- creation (Ec2BoxCreator.create parity) -------------------------
+    def create_command(self) -> list[str]:
+        s = self.spec
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "create", s.name,
+               f"--zone={s.zone}",
+               f"--accelerator-type={s.accelerator_type}",
+               f"--version={s.runtime_version}"]
+        if s.project:
+            cmd.append(f"--project={s.project}")
+        if s.spot:
+            cmd.append("--spot")
+        return cmd
+
+    def delete_command(self) -> list[str]:
+        s = self.spec
+        return ["gcloud", "compute", "tpus", "tpu-vm", "delete", s.name,
+                f"--zone={s.zone}", "--quiet"]
+
+    # -- per-host bootstrap (HostProvisioner parity) --------------------
+    def bootstrap_command(self, repo_url: str,
+                          workdir: str = "~/deeplearning4j_tpu") -> str:
+        """What ``HostProvisioner`` uploads+runs over SSH: fetch the
+        framework and its deps onto every host."""
+        return (f"git clone {shlex.quote(repo_url)} {workdir} 2>/dev/null "
+                f"|| git -C {workdir} pull && "
+                f"pip install -U jax[tpu] flax optax orbax-checkpoint")
+
+    def ssh_all_command(self, remote_cmd: str) -> list[str]:
+        s = self.spec
+        return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", s.name,
+                f"--zone={s.zone}", "--worker=all",
+                f"--command={remote_cmd}"]
+
+    # -- coordinated launch (ClusterSetup + jax.distributed contract) ----
+    def launch_env(self, process_id: int, coordinator_host: str) -> dict[str, str]:
+        """Per-host env for ``initialize_multihost`` (the Akka-seed-join
+        replacement): coordinator on host 0, one process per host."""
+        s = self.spec
+        return {
+            "JAX_COORDINATOR_ADDRESS": f"{coordinator_host}:{s.coordinator_port}",
+            "JAX_NUM_PROCESSES": str(s.n_hosts),
+            "JAX_PROCESS_ID": str(process_id),
+        }
+
+    def launch_command(self, train_argv: str, coordinator_host: str,
+                       workdir: str = "~/deeplearning4j_tpu") -> str:
+        """One command runnable via ``--worker=all``: each host derives its
+        process id from the TPU metadata worker index and starts the same
+        program (SPMD single-controller-per-host)."""
+        s = self.spec
+        env = " ".join(
+            f"{k}={v}" for k, v in self.launch_env(0, coordinator_host).items()
+            if k != "JAX_PROCESS_ID")
+        return (f"cd {workdir} && {env} "
+                "JAX_PROCESS_ID=$(curl -s -H 'Metadata-Flavor: Google' "
+                "'http://metadata/computeMetadata/v1/instance/attributes/"
+                "agent-worker-number') "
+                f"python {train_argv}")
+
+    # -- one-file artifact ----------------------------------------------
+    def render_script(self, repo_url: str, train_argv: str,
+                      coordinator_host: str = "$(gcloud compute tpus tpu-vm "
+                      "describe {name} --zone={zone} --format="
+                      "'value(networkEndpoints[0].ipAddress)')") -> str:
+        s = self.spec
+        coord = coordinator_host.format(name=s.name, zone=s.zone)
+        lines = [
+            "#!/usr/bin/env bash",
+            "# Auto-generated pod-slice provisioning script "
+            f"({s.accelerator_type}, {s.n_hosts} hosts, {s.n_chips} chips)",
+            "set -euo pipefail",
+            "",
+            "# 1. create the slice",
+            shlex.join(self.create_command()),
+            "",
+            "# 2. bootstrap every host",
+            shlex.join(self.ssh_all_command(self.bootstrap_command(repo_url))),
+            "",
+            "# 3. resolve coordinator (host 0) and launch everywhere",
+            f'COORD={coord}',
+            # manual quoting: $COORD must expand in the OUTER shell, so the
+            # --command payload is double-quoted, not shlex-single-quoted
+            # $COORD expands on the operator machine; the $(curl ...) worker-
+            # index lookup is escaped so it runs on each TPU host instead
+            (shlex.join(self.ssh_all_command("")[:-1])
+             + ' "--command=' + self.launch_command(train_argv, "$COORD")
+             .replace('"', '\\"').replace("$(curl", "\\$(curl") + '"'),
+            "",
+        ]
+        return "\n".join(lines)
+
+    def write_script(self, path: str | Path, repo_url: str,
+                     train_argv: str) -> Path:
+        path = Path(path)
+        path.write_text(self.render_script(repo_url, train_argv))
+        path.chmod(0o755)
+        return path

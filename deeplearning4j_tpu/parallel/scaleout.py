@@ -287,6 +287,13 @@ class StateTracker:
         with self._lock:
             self._done = True
 
+    def reset_done(self) -> None:
+        """Clear a leftover finish flag (a master starting a new run on a
+        reused tracker/state dir must not no-op on the previous run's
+        DONE)."""
+        with self._lock:
+            self._done = False
+
     def is_done(self) -> bool:
         with self._lock:
             return self._done
@@ -432,12 +439,15 @@ class DistributedRunner:
 
     # -- master loop ----------------------------------------------------
     def run(self, max_wall_s: float = 300.0) -> Any:
+        self.tracker.reset_done()    # a prior run's DONE must not no-op us
         self._spawn_workers()
         deadline = time.time() + max_wall_s
         last_evict = time.time()
         requeue: list[Job] = []  # orphaned jobs from evicted workers
         try:
             while time.time() < deadline:
+                if self.tracker.is_done():
+                    break            # external kill (Kill CLI / finish flag)
                 # eviction sweep (reference: every 60 s; scaled to poll rate);
                 # orphaned in-flight jobs are re-routed to live workers
                 if time.time() - last_evict > max(1.0, self.eviction_timeout_s / 2):

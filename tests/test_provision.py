@@ -1,0 +1,105 @@
+"""Provisioning analog (reference ``deeplearning4j-aws``: Ec2BoxCreator /
+ClusterSetup / HostProvisioner) + the YARN Kill CLI analog."""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from deeplearning4j_tpu.parallel.procstate import FileStateTracker
+from deeplearning4j_tpu.parallel.provision import (
+    PodSliceProvisioner, PodSliceSpec)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_pod_slice_spec_geometry():
+    s = PodSliceSpec(accelerator_type="v5litepod-64")
+    assert s.n_chips == 64 and s.n_hosts == 16       # v5e: 4-chip hosts
+    assert PodSliceSpec(accelerator_type="v5litepod-8").n_hosts == 2
+
+
+def test_create_and_launch_commands():
+    spec = PodSliceSpec(name="slice1", accelerator_type="v5litepod-16",
+                        zone="us-west4-a", spot=True)
+    prov = PodSliceProvisioner(spec)
+    create = prov.create_command()
+    assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "--accelerator-type=v5litepod-16" in create
+    assert "--spot" in create
+
+    env = prov.launch_env(3, "10.0.0.2")
+    assert env == {"JAX_COORDINATOR_ADDRESS": "10.0.0.2:8476",
+                   "JAX_NUM_PROCESSES": "4", "JAX_PROCESS_ID": "3"}
+
+    launch = prov.launch_command("-m deeplearning4j_tpu train", "$COORD")
+    assert "JAX_COORDINATOR_ADDRESS=$COORD:8476" in launch
+    assert "JAX_NUM_PROCESSES=4" in launch
+    assert "agent-worker-number" in launch           # per-host process id
+
+
+def test_render_script_is_wellformed(tmp_path):
+    prov = PodSliceProvisioner(PodSliceSpec(accelerator_type="v5litepod-8"))
+    path = prov.write_script(tmp_path / "up.sh", "https://example.com/r.git",
+                             "-m deeplearning4j_tpu train")
+    text = path.read_text()
+    assert text.startswith("#!/usr/bin/env bash")
+    assert "set -euo pipefail" in text
+    assert "tpu-vm create" in text and "--worker=all" in text
+    # remote worker-index lookup must be escaped for the outer shell
+    assert "\\$(curl" in text
+    assert os.access(path, os.X_OK)
+    # the script parses as shell
+    subprocess.run(["bash", "-n", str(path)], check=True)
+
+
+def test_cli_scaleout_kill(tmp_path):
+    state = tmp_path / "state"
+    FileStateTracker(state)          # create the layout
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "scaleout", "-t", "kill",
+         "--state-dir", str(state)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert FileStateTracker(state).is_done()
+
+
+def test_kill_stops_running_master(tmp_path):
+    """A kill issued while a master waits on an empty-but-unfinished job
+    stream makes the whole run wind down (Kill.java behavior)."""
+    from deeplearning4j_tpu.parallel.procrunner import ProcessDistributedRunner
+    from deeplearning4j_tpu.parallel.scaleout import CollectionJobIterator
+
+    state = tmp_path / "state"
+
+    class NeverDone:
+        """Iterator that claims more work is coming (streaming master)."""
+
+        def next(self, worker_id=""):
+            raise AssertionError("never dispenses")
+
+        def has_next(self):
+            return False
+
+        def reset(self):
+            pass
+
+    runner = ProcessDistributedRunner(
+        CollectionJobIterator(["a b", "c"]),
+        "deeplearning4j_tpu.parallel.performers:WordCountPerformer",
+        state_dir=state, n_workers=1,
+        worker_env={"JAX_PLATFORMS": "cpu"})
+
+    import threading
+    killer = threading.Thread(
+        target=lambda: (time.sleep(1.5), FileStateTracker(state).finish()),
+        daemon=True)
+    killer.start()
+    t0 = time.time()
+    runner.run(max_wall_s=60.0)
+    # jobs drain quickly; kill (or natural finish) must not hang to the wall
+    assert time.time() - t0 < 50.0
+    assert FileStateTracker(state).is_done()
