@@ -20,9 +20,10 @@ supersteps + ZooKeeper config, SURVEY.md §2.3) collapses on TPU into:
 from .mesh import MeshSpec, local_mesh, make_mesh
 from .trainer import DataParallelTrainer, TrainState
 from .checkpoint import CheckpointManager
+from .driver import Driver
 
 __all__ = [
     "MeshSpec", "local_mesh", "make_mesh",
     "DataParallelTrainer", "TrainState",
-    "CheckpointManager",
+    "CheckpointManager", "Driver",
 ]

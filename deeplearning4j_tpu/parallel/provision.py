@@ -23,8 +23,11 @@ from pathlib import Path
 
 __all__ = ["PodSliceSpec", "PodSliceProvisioner"]
 
-# chips per host is fixed per accelerator generation (v5e: 4-chip hosts)
-_CHIPS_PER_HOST = {"v5litepod": 4, "v5p": 4, "v4": 4, "v3": 8, "v2": 8}
+# The accelerator-type numeric suffix counts CHIPS for v5e (v5litepod-N)
+# but TENSORCORES (2 per chip) for v2/v3/v4/v5p; every generation here
+# packs 4 chips per host.
+_SUFFIX_COUNTS_CHIPS = {"v5litepod"}
+_CHIPS_PER_HOST = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,12 +49,14 @@ class PodSliceSpec:
 
     @property
     def n_chips(self) -> int:
-        return int(self.accelerator_type.rsplit("-", 1)[1])
+        suffix = int(self.accelerator_type.rsplit("-", 1)[1])
+        if self.generation in _SUFFIX_COUNTS_CHIPS:
+            return suffix
+        return max(1, suffix // 2)       # core-counted generations
 
     @property
     def n_hosts(self) -> int:
-        per = _CHIPS_PER_HOST.get(self.generation, 4)
-        return max(1, self.n_chips // per)
+        return max(1, self.n_chips // _CHIPS_PER_HOST)
 
 
 class PodSliceProvisioner:

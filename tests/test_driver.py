@@ -1,0 +1,86 @@
+"""Driver (single-controller entry point) — names the reference's
+Spark-driver/YARN-master role (VERDICT r3 coverage row 50) — plus the
+inverted index's new disk persistence (row 61)."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel.driver import Driver
+from deeplearning4j_tpu.parallel.mesh import MeshSpec
+
+
+class _Batch:
+    def __init__(self, x, y):
+        self.features, self.labels = x, y
+
+
+def _problem():
+    w_true = jnp.asarray([1.0, -2.0, 0.5])
+    x = jax.random.normal(jax.random.key(0), (64, 3))
+    y = x @ w_true
+    params = {"w": jnp.zeros(3)}
+
+    def loss_fn(p, xb, yb, key=None):
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    batches = [_Batch(x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8])
+               for i in range(8)]
+    return params, loss_fn, batches, w_true
+
+
+def test_driver_trains_checkpoints_and_serves_status(tmp_path):
+    params, loss_fn, batches, w_true = _problem()
+    driver = Driver(loss_fn, T.chain(T.momentum(0.9), T.sgd_lr(5e-2)),
+                    mesh_spec=MeshSpec(dp=8),
+                    checkpoint_dir=tmp_path / "ckpt", checkpoint_every=4,
+                    status_port=0)
+    try:
+        state, losses = driver.run(params, batches, epochs=10)
+        assert losses[-1] < losses[0] * 0.1
+        w = np.asarray(driver.final_params(state)["w"])
+        np.testing.assert_allclose(w, np.asarray(w_true), atol=0.2)
+        assert driver.checkpoint_manager.latest_step() is not None
+        # observability wired through
+        metrics = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{driver.status_server.port}/metrics",
+            timeout=10).read())
+        assert metrics["counters"]["driver.steps"] >= len(losses)
+    finally:
+        driver.close()
+
+
+def test_driver_resumes_from_checkpoint(tmp_path):
+    params, loss_fn, batches, _ = _problem()
+    tx = T.chain(T.momentum(0.9), T.sgd_lr(5e-2))
+
+    d1 = Driver(loss_fn, tx, mesh_spec=MeshSpec(dp=8),
+                checkpoint_dir=tmp_path / "ckpt", checkpoint_every=2)
+    s1, _ = d1.run(params, batches, epochs=1)
+
+    # a fresh driver process resumes at the saved step, not from scratch
+    d2 = Driver(loss_fn, tx, mesh_spec=MeshSpec(dp=8),
+                checkpoint_dir=tmp_path / "ckpt")
+    s2, losses2 = d2.run(params, batches, epochs=1)
+    assert s2.step == s1.step
+    assert losses2 == []             # nothing left to do at the same epoch count
+
+
+def test_inverted_index_save_load(tmp_path):
+    from deeplearning4j_tpu.text.index import InvertedIndex
+
+    idx = InvertedIndex()
+    idx.add_doc("the quick brown fox", label="a")
+    idx.add_doc("the lazy dog", label="b")
+    idx.save(tmp_path / "corpus.idx.gz")
+
+    idx2 = InvertedIndex.load(tmp_path / "corpus.idx.gz")
+    assert idx2.num_documents() == 2
+    assert idx2.label(1) == "b"
+    assert idx2.documents_for("the") == [0, 1]
+    assert idx2.search("quick fox")[0][0] == 0
+    assert idx2.all_docs() == idx.all_docs()

@@ -103,3 +103,29 @@ def test_kill_stops_running_master(tmp_path):
     # jobs drain quickly; kill (or natural finish) must not hang to the wall
     assert time.time() - t0 < 50.0
     assert FileStateTracker(state).is_done()
+
+
+def test_core_counted_generations():
+    """v4/v5p accelerator-type suffixes count TensorCores (2/chip), not
+    chips; v5litepod suffixes count chips."""
+    assert PodSliceSpec(accelerator_type="v4-8").n_chips == 4
+    assert PodSliceSpec(accelerator_type="v4-8").n_hosts == 1
+    assert PodSliceSpec(accelerator_type="v3-8").n_hosts == 1
+    assert PodSliceSpec(accelerator_type="v5p-128").n_chips == 64
+    assert PodSliceSpec(accelerator_type="v5litepod-64").n_chips == 64
+
+
+def test_driver_wildcard_mesh_uses_all_devices():
+    import jax
+
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel.driver import Driver
+    from deeplearning4j_tpu.parallel.mesh import MeshSpec
+
+    import jax.numpy as jnp
+
+    def loss_fn(p, xb, yb, key=None):
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    d = Driver(loss_fn, T.sgd_lr(1e-2), mesh_spec=MeshSpec(tp=2))
+    assert d.mesh.devices.size == len(jax.devices())   # wildcard dp fills
