@@ -1,16 +1,21 @@
-"""In-memory inverted index.
+"""Inverted index with optional on-disk persistence.
 
 Capability match of ``text/invertedindex/InvertedIndex.java:17`` +
 ``LuceneInvertedIndex.java`` (912 LoC): document ingestion, posting lists,
-term/document lookups, batch iteration for embedding training, and simple
-TF-IDF ranked search — without the Lucene dependency (the reference embeds
-Lucene purely as a corpus store for Word2Vec batching).
+term/document lookups, batch iteration for embedding training, simple
+TF-IDF ranked search, and — like Lucene's on-disk segments — a compact
+save/load so a large corpus index survives process restarts, all without
+the Lucene dependency (the reference embeds Lucene purely as a corpus
+store for Word2Vec batching).
 """
 
 from __future__ import annotations
 
+import gzip
+import json
 import math
 from collections import defaultdict
+from pathlib import Path
 from typing import Callable, Iterable, Iterator, Sequence
 
 from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
@@ -67,6 +72,29 @@ class InvertedIndex:
 
     def all_docs(self) -> list[list[str]]:
         return list(self._docs)
+
+    # ------------------------------------------------------------------ persist
+    def save(self, path: str | Path) -> None:
+        """Persist docs+labels as gzipped JSON lines (the Lucene-directory
+        role); postings are rebuilt on load, so the file stays one
+        source of truth."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        with gzip.open(tmp, "wt", encoding="utf-8") as f:
+            for tokens, label in zip(self._docs, self._labels):
+                f.write(json.dumps({"t": tokens, "l": label}) + "\n")
+        tmp.replace(path)
+
+    @classmethod
+    def load(cls, path: str | Path, tokenizer_factory=None) -> "InvertedIndex":
+        idx = cls(tokenizer_factory)
+        with gzip.open(Path(path), "rt", encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    idx.add_doc(rec["t"], rec.get("l"))
+        return idx
 
     # ------------------------------------------------------------------ search
     def search(self, query: str, n: int = 10) -> list[tuple[int, float]]:

@@ -72,3 +72,36 @@ def test_curves_fetcher_shapes_and_determinism():
     other = CurvesDataFetcher(n_examples=128, seed=4)
     other.fetch(64)
     assert np.abs(other.next().features - ds.features).sum() > 0
+
+
+def test_denoising_autoencoder_learns_curves():
+    """The Curves corpus's actual use (deep-autoencoder pretraining,
+    ``CurvesDataFetcher.java``): a denoising AE's reconstruction loss on
+    curve images drops well below its starting point."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn import layers as L
+    from deeplearning4j_tpu.nn.conf import LayerKind, NeuralNetConfiguration
+
+    ds = CurvesDataSetIterator(batch=128, n_examples=128, seed=0).next()
+    x = jnp.asarray(ds.features)
+
+    conf = NeuralNetConfiguration(kind=LayerKind.AUTOENCODER, n_in=784,
+                                  n_out=64, corruption_level=0.1, lr=0.5,
+                                  activation="sigmoid", seed=0)
+    layer = L.create_layer(conf)
+    params = layer.init(jax.random.key(0))
+    key = jax.random.key(1)
+    loss0, _ = layer.pretrain_value_and_grad(params, x, key)
+
+    @jax.jit
+    def step(p, k):
+        _, g = layer.pretrain_value_and_grad(p, x, k)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g)
+
+    for i in range(80):
+        key, sub = jax.random.split(key)
+        params = step(params, sub)
+    loss1, _ = layer.pretrain_value_and_grad(params, x, key)
+    assert float(loss1) < 0.7 * float(loss0), (float(loss0), float(loss1))
