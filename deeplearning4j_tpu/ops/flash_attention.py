@@ -76,7 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
 
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
@@ -100,15 +100,18 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0), **mem),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i), **mem),
+            # lse carries a trailing singleton: Mosaic requires the last two
+            # block dims divisible by (8, 128) or equal to the array dims, so
+            # a (1, block_q) block is unlowerable while (1, block_q, 1) is.
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0), **mem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse[..., 0]
 
 
 def _blockwise_bwd(q, k, v, out, lse, do, causal, block_k):

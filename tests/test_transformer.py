@@ -257,3 +257,27 @@ def test_long_context_ring_attention_sp8():
     np.testing.assert_allclose(float(loss1), float(loss0), rtol=2e-4)
     np.testing.assert_allclose(np.asarray(p1b["layers"][0]["w1"]),
                                np.asarray(p0b["layers"][0]["w1"]), atol=2e-4)
+
+
+def test_chunked_xent_matches_unchunked():
+    """The streaming LM loss (xent_chunk scan + per-chunk remat) is an
+    implementation detail: loss AND grads must match the full-logits path
+    bit-for-bit-ish in f32."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models.transformer import lm_head_loss
+
+    cfg = tiny_cfg(vocab_size=128, xent_chunk=16)
+    cfg0 = dataclasses.replace(cfg, xent_chunk=0)
+    params = init_params(jax.random.key(0), cfg)
+    h = jax.random.normal(jax.random.key(1), (4, 32, 32))
+    targets = jax.random.randint(jax.random.key(2), (4, 32), 0, 128)
+
+    l_chunk = lm_head_loss(params, h, targets, cfg)
+    l_full = lm_head_loss(params, h, targets, cfg0)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+
+    g_chunk = jax.grad(lambda p: lm_head_loss(p, h, targets, cfg))(params)
+    g_full = jax.grad(lambda p: lm_head_loss(p, h, targets, cfg0))(params)
+    for a, b in zip(jax.tree.leaves(g_chunk), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -1,0 +1,125 @@
+"""One-off TPU tuning sweep: measure BERT/ResNet leg variants on the real
+chip to pick bench.py's config (batch size, attention path).  Not part of
+the benchmark contract — bench.py remains the single source of truth; this
+script only informs which knobs bench.py should default to.
+
+Usage: python tools/tune_tpu.py bert|resnet|flash
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(ts):
+    ts = sorted(ts)
+    return ts[len(ts) // 2]
+
+
+def bert_variant(batch, seq, attention, remat=False, iters=8):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
+                            n_layers=12, d_ff=3072, max_len=seq,
+                            causal=False, dtype=jnp.bfloat16, remat=remat,
+                            attention=attention)
+    model = TransformerLM(cfg)
+    tx = T.adamw(T.warmup_cosine(1e-4, 10, 1000), weight_decay=0.01)
+    params = model.init(jax.random.key(0))
+    opt = model.init_opt(params, tx)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    a, b = jax.device_put(toks), jax.device_put(np.roll(toks, -1, 1))
+    step = model.build_train_step(tx)
+    params, opt, loss = step(params, opt, a, b)
+    float(np.asarray(loss))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, opt, loss = step(params, opt, a, b)
+        float(np.asarray(loss))
+        times.append(time.perf_counter() - t0)
+    med = _median(times)
+    flops = cfg.flops_per_token() * batch * seq
+    return {"batch": batch, "seq": seq, "attention": attention,
+            "remat": remat, "median_ms": round(med * 1e3, 2),
+            "tokens_per_sec": round(batch * seq / med, 1),
+            "mfu": round(flops / (med * 197e12), 4)}
+
+
+def resnet_variant(batch, iters=8):
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.resnet import (ResNetConfig, cross_entropy,
+                                                  init_params)
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.optimize.transforms import apply_updates
+
+    cfg = ResNetConfig.resnet50()
+    tx = T.chain(T.momentum(0.9), T.sgd_lr(1e-2))
+
+    def step(params, opt, images, labels):
+        count, st = opt
+        loss, g = jax.value_and_grad(cross_entropy)(params, images, labels, cfg)
+        updates, st = tx.update(g, st, params, count)
+        return apply_updates(params, updates), (count + 1, st), loss
+
+    params = init_params(jax.random.key(0), cfg)
+    opt = (jnp.zeros((), jnp.int32), tx.init(params))
+    rng = np.random.default_rng(1)
+    imgs = rng.standard_normal((batch, 224, 224, 3), dtype=np.float32)
+    onehot = np.eye(cfg.num_classes, dtype=np.float32)[
+        rng.integers(0, cfg.num_classes, batch)]
+    a, b = jax.device_put(imgs), jax.device_put(onehot)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    params, opt, loss = jstep(params, opt, a, b)
+    float(np.asarray(loss))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, opt, loss = jstep(params, opt, a, b)
+        float(np.asarray(loss))
+        times.append(time.perf_counter() - t0)
+    med = _median(times)
+    flops = cfg.flops_per_image(224) * batch
+    return {"batch": batch, "median_ms": round(med * 1e3, 2),
+            "images_per_sec": round(batch / med, 1),
+            "mfu": round(flops / (med * 197e12), 4)}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    out = []
+    if which == "bert":
+        for batch in (64, 128, 256):
+            try:
+                out.append(bert_variant(batch, 512, "ring"))
+            except Exception as e:
+                out.append({"batch": batch, "error": repr(e)[:200]})
+            print(json.dumps(out[-1]), flush=True)
+    elif which == "flash":
+        for batch in (64, 128):
+            try:
+                out.append(bert_variant(batch, 512, "flash"))
+            except Exception as e:
+                out.append({"batch": batch, "error": repr(e)[:200]})
+            print(json.dumps(out[-1]), flush=True)
+    elif which == "resnet":
+        for batch in (128, 256):
+            try:
+                out.append(resnet_variant(batch))
+            except Exception as e:
+                out.append({"batch": batch, "error": repr(e)[:200]})
+            print(json.dumps(out[-1]), flush=True)
+
+
+if __name__ == "__main__":
+    main()
