@@ -26,6 +26,14 @@ class ResNetConfig:
     width: int = 64
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    stem_space_to_depth: bool = True  # rewrite the 7x7/2 stem conv as an
+    #                                   exactly-equivalent 4x4/1 conv on a
+    #                                   2x2 space-to-depth input: C_in=3 is
+    #                                   MXU-hostile (contraction 7*7*3=147,
+    #                                   channels padded to the 128 lane);
+    #                                   the s2d form contracts over 192 with
+    #                                   12 input channels (standard TPU
+    #                                   ResNet optimization)
 
     @classmethod
     def resnet18(cls, num_classes=1000, **kw):
@@ -136,10 +144,37 @@ def _bottleneck(x, blk, stride, dtype):
     return jax.nn.relu(x + h)
 
 
+def _space_to_depth(x):
+    """(N, H, W, C) -> (N, H/2, W/2, 4C), channel-minor order (a, b, c)."""
+    N, H, W, C = x.shape
+    x = x.reshape(N, H // 2, 2, W // 2, 2, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(N, H // 2, W // 2, 4 * C)
+
+
+def _stem_s2d_kernel(w):
+    """Rearrange the (7, 7, C, O) stride-2 stem kernel into the (4, 4, 4C, O)
+    stride-1 kernel that computes the identical map on a space-to-depth
+    input: pad to 8x8 (the extra taps are zero), then space-to-depth the
+    kernel itself with the same (a, b, c) channel order as the input."""
+    _, _, C, O = w.shape
+    wp = jnp.pad(w, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    wp = wp.reshape(4, 2, 4, 2, C, O)
+    return wp.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, O)
+
+
 def forward(params, images, cfg: ResNetConfig) -> jnp.ndarray:
     """images: (N, H, W, 3) -> logits (N, num_classes)."""
     dt = cfg.dtype
-    x = _conv(images, params["stem"]["conv"], 2, dt)
+    N, H, W, _ = images.shape
+    if cfg.stem_space_to_depth and H % 2 == 0 and W % 2 == 0:
+        # SAME on the s2d conv reproduces SAME on the original exactly:
+        # k=7 s=2 pads (2, 3) on 2H -> k=4 s=1 pads (1, 2) on H
+        w = _stem_s2d_kernel(params["stem"]["conv"]).astype(dt)
+        x = lax.conv_general_dilated(
+            _space_to_depth(images).astype(dt), w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    else:
+        x = _conv(images, params["stem"]["conv"], 2, dt)
     x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
     x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
     for s, stage in enumerate(params["stages"]):

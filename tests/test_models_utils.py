@@ -150,3 +150,25 @@ def test_preprocessor_serde_roundtrip():
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
     back = MultiLayerConfiguration.from_json(net.conf.to_json())
     assert back.preprocessors == {0: "flatten"}
+
+
+def test_resnet_s2d_stem_matches_plain_stem():
+    """The space-to-depth stem (4x4/1 conv on a 2x2-s2d input) is an exact
+    rearrangement of the 7x7/2 conv — logits must match the plain stem to
+    summation-order noise."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models.resnet import forward, init_params
+
+    cfg = ResNetConfig.resnet18(num_classes=5, width=8, dtype=jnp.float32)
+    assert cfg.stem_space_to_depth
+    params = init_params(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    with jax.default_matmul_precision("highest"):
+        y_s2d = forward(params, x, cfg)
+        y_plain = forward(params, x,
+                          dataclasses.replace(cfg, stem_space_to_depth=False))
+    # stem outputs agree to ~1e-6; batch-norm rsqrt amplifies that
+    # summation-order noise through the stack, hence the loose logit atol
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_plain),
+                               atol=2e-3)
