@@ -1,0 +1,58 @@
+"""Train skip-gram word embeddings and query nearest neighbors.
+
+The reference workflow (``models/word2vec/Word2Vec.java:42``): build vocab,
+Huffman-code it, train hierarchical-softmax skip-gram, then probe with
+similarity queries and save in the Google text format
+(``WordVectorSerializer``-compatible round trip).
+
+Run:  python examples/02_word2vec.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import tempfile
+
+from deeplearning4j_tpu.text.serializer import load_txt, save_txt
+from deeplearning4j_tpu.text.word2vec import Word2Vec
+
+CORPUS = [
+    "the apple is a sweet fruit",
+    "banana is a yellow fruit and the banana is sweet",
+    "orange fruit is sweet and orange is juicy",
+    "apple and banana and orange are fruit",
+    "fruit salad has apple banana orange",
+    "the car drives on the road",
+    "a truck is a big car on the road",
+    "the bus drives people on the road",
+    "car truck and bus are vehicles on the road",
+    "vehicles like car and bus drive fast",
+] * 8
+
+
+def main():
+    w2v = Word2Vec(CORPUS, layer_size=32, window=3, iterations=8,
+                   min_word_frequency=3, seed=7)
+    w2v.fit()
+
+    print(f"nearest to 'car': {w2v.words_nearest('car', 4)}")
+    within = w2v.similarity("apple", "banana")
+    cross = w2v.similarity("apple", "road")
+    print(f"sim(apple, banana) = {within:.3f}  (same topic)")
+    print(f"sim(apple, road)   = {cross:.3f}  (cross topic)")
+    assert within > cross, "within-topic similarity should beat cross-topic"
+
+    with tempfile.NamedTemporaryFile(suffix=".txt") as f:
+        words = [w2v.vocab.word_at(i) for i in range(len(w2v.vocab))]
+        save_txt(words, w2v.syn0, f.name)
+        words2, _ = load_txt(f.name)
+        print(f"round-tripped {len(words2)} vectors through Google txt format")
+
+
+if __name__ == "__main__":
+    main()

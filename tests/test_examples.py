@@ -1,0 +1,31 @@
+"""Smoke-run the examples/ scripts — they are user-facing documentation and
+must keep working (mirror of the reference's example-shaped tests, e.g.
+``MultiLayerTest`` / ``WordCountTest``)."""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(script: str, timeout: float = 300.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / script)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    return proc.stdout
+
+
+def test_example_iris_mlp():
+    out = _run("01_iris_mlp.py")
+    assert "F1 = " in out
+
+
+def test_example_distributed_wordcount():
+    out = _run("04_distributed_wordcount.py")
+    assert "top words:" in out
