@@ -65,7 +65,7 @@ print(d.platform, d.device_kind, len(devs), f"{time.time()-t0:.1f}s")
 
 
 def _discover_devices(attempts: int = None, timeout_s: float = None,
-                      backoff_s: float = 15.0):
+                      backoff_s: float = 60.0):
     """Probe the TPU backend in a SUBPROCESS (an in-thread probe that hangs
     would wedge jax's backend lock and deadlock the CPU fallback too); only
     touch the TPU platform in-process once the probe proves it healthy.
@@ -76,11 +76,11 @@ def _discover_devices(attempts: int = None, timeout_s: float = None,
     stderr tail into the artifact so a fallback is diagnosable.
 
     ``BENCH_PROBE_ATTEMPTS`` / ``BENCH_PROBE_TIMEOUT_S`` env vars override
-    the schedule (defaults 3 x 180s)."""
+    the schedule (defaults 4 x 180s, 60s backoff)."""
     import jax
 
     if attempts is None:
-        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
     if timeout_s is None:
         timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
 
@@ -548,6 +548,17 @@ def main():
     # when we could not reach the chip, at least prove the REAL configs
     # compile and record XLA's FLOPs for them (no timing claim)
     real_compile = None if on_tpu else _real_config_compile_check()
+    # ... and surface the most recent guard-passing TPU run (written by a
+    # prior successful invocation below) so a transient relay outage does
+    # not erase the round's evidence; clearly labeled as NOT this run.
+    last_valid = None
+    if not on_tpu:
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "LAST_VALID_TPU_BENCH.json")) as f:
+                last_valid = json.load(f)
+        except Exception:
+            pass
 
     bst = bert["stats"]
     metric = ("bert_base_train_tokens_per_sec" if on_tpu
@@ -582,6 +593,7 @@ def main():
         "wall_s": round(time.time() - t_start, 1),
         **({"fallback": fallback_reason} if fallback_reason else {}),
         **({"probe_failures": probe_failures} if probe_failures else {}),
+        **({"last_valid_tpu_run_NOT_this_run": last_valid} if last_valid else {}),
     }
 
     if problems and not on_tpu:
@@ -606,6 +618,15 @@ def main():
         "extra": extra,
     }
     print(json.dumps(out))
+    if on_tpu:                        # persist guard-passing evidence
+        try:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "LAST_VALID_TPU_BENCH.json")
+            with open(path, "w") as f:
+                json.dump(out, f)
+                f.write("\n")
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
