@@ -95,9 +95,120 @@ def resnet_variant(batch, iters=8):
             "mfu": round(flops / (med * 197e12), 4)}
 
 
+def bert_ablate(batch=64, seq=512, iters=8):
+    """Attribute step time: full train step vs fwd+bwd without optimizer vs
+    encoder-only (no LM head) — the deltas localize optimizer and loss cost."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM,
+                                                       encode_local,
+                                                       lm_loss_local)
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
+                            n_layers=12, d_ff=3072, max_len=seq,
+                            causal=False, dtype=jnp.bfloat16, remat=False)
+    model = TransformerLM(cfg)
+    tx = T.adamw(T.warmup_cosine(1e-4, 10, 1000), weight_decay=0.01)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    a = jax.device_put(toks)
+    b = jax.device_put(np.roll(toks, -1, 1))
+
+    def time_fn(fn, *args):
+        r = fn(*args)
+        jax.block_until_ready(r)
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            ts.append(time.perf_counter() - t0)
+        return round(_median(ts) * 1e3, 2)
+
+    out = {}
+    opt = model.init_opt(params, tx)
+    step = model.build_train_step(tx)
+    r = step(params, opt, a, b)          # compile; donation -> rebuild below
+    jax.block_until_ready(r)
+    params2, opt2, _ = r
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params2, opt2, loss = step(params2, opt2, a, b)
+        float(np.asarray(loss))
+        ts.append(time.perf_counter() - t0)
+    out["full_step_ms"] = round(_median(ts) * 1e3, 2)
+
+    grad_fn = jax.jit(jax.grad(lambda p: lm_loss_local(p, a, b, cfg)))
+    out["grad_only_ms"] = time_fn(grad_fn, params2)
+    loss_fn = jax.jit(lambda p: lm_loss_local(p, a, b, cfg))
+    out["fwd_loss_ms"] = time_fn(loss_fn, params2)
+    enc_fn = jax.jit(lambda p: encode_local(p, a, cfg).mean())
+    out["fwd_encode_ms"] = time_fn(enc_fn, params2)
+    return out
+
+
+def flash_check():
+    """Correctness of the Pallas kernel vs the XLA ring path on-chip, then
+    its speed inside the full model."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.transformer import ring_attention
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    B, T, H, D = 4, 512, 12, 64
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.bfloat16)
+               for _ in range(3))
+    res = {}
+    for causal in (False, True):
+        f = jax.jit(lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c))
+        r = jax.jit(lambda q, k, v, c=causal: ring_attention(
+            q, k, v, n_sp=1, sp_axis=None, causal=c, t_local=T))
+        err = float(np.max(np.abs(np.asarray(f(q, k, v), np.float32)
+                                  - np.asarray(r(q, k, v), np.float32))))
+        res[f"fwd_err_causal_{causal}"] = round(err, 5)
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, causal=False).astype(jnp.float32) ** 2).mean()
+
+    def loss_r(q, k, v):
+        return (ring_attention(q, k, v, n_sp=1, sp_axis=None, causal=False,
+                               t_local=T).astype(jnp.float32) ** 2).mean()
+
+    gf = jax.jit(jax.grad(loss_f))(q, k, v)
+    gr = jax.jit(jax.grad(loss_r))(q, k, v)
+    res["grad_err"] = round(float(np.max(np.abs(
+        np.asarray(gf, np.float32) - np.asarray(gr, np.float32)))), 5)
+    return res
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     out = []
+    if which == "post":
+        # post-change battery: chunked-xent BERT (ring + flash) and the
+        # space-to-depth ResNet at growing batch
+        try:
+            print(json.dumps({"flash_check": flash_check()}), flush=True)
+        except Exception as e:
+            print(json.dumps({"flash_check_error": repr(e)[:300]}), flush=True)
+        for fn, args in ((bert_variant, (64, 512, "ring")),
+                         (bert_variant, (64, 512, "flash")),
+                         (bert_variant, (128, 512, "flash")),
+                         (resnet_variant, (256,)),
+                         (resnet_variant, (512,))):
+            try:
+                print(json.dumps(fn(*args)), flush=True)
+            except Exception as e:
+                print(json.dumps({"args": str(args),
+                                  "error": repr(e)[:300]}), flush=True)
+        return
+    if which == "ablate":
+        print(json.dumps(bert_ablate()), flush=True)
+        return
     if which == "bert":
         for batch in (64, 128, 256):
             try:
