@@ -44,7 +44,10 @@ def test_make_mesh_axes():
 
 
 def test_collectives_via_shard_map():
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map           # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     mesh = local_mesh()
 
