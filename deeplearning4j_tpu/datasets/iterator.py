@@ -327,3 +327,40 @@ class MovingWindowDataSetIterator(_IterBase):
 
     def __iter__(self):
         return iter(self._list)
+
+
+def prefetch_to_device(iterable, size: int = 2, sharding=None):
+    """Double-buffered host->device staging (SURVEY §7 L3: "double-buffered
+    host->device transfer"; the role the reference fills with its fetcher
+    cursor + Akka batch actor hand-off).
+
+    Issues ``jax.device_put`` for up to ``size`` batches ahead of the
+    consumer: JAX transfers are asynchronous, so the copy of batch k+1
+    overlaps the device compute of batch k without any helper thread.
+    Works on (features, labels) tuples, DataSets, or any pytree of host
+    arrays; ``sharding`` (e.g. a NamedSharding) places each leaf when given.
+    """
+    import collections
+
+    import jax
+
+    def put(batch):
+        leaves, treedef = jax.tree.flatten(batch)
+        leaves = [jax.device_put(x, sharding) if hasattr(x, "shape") else x
+                  for x in leaves]
+        return jax.tree.unflatten(treedef, leaves)
+
+    queue = collections.deque()
+    it = iter(iterable)
+    try:
+        while len(queue) < max(1, size):
+            queue.append(put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

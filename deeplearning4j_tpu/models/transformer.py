@@ -608,8 +608,12 @@ class TransformerLM:
 
         losses = []
         total = epochs * len(batches)
-        while int(opt[0]) < total:
-            a, b = batches[int(opt[0]) % len(batches)]
+        # double-buffered host->device staging: the device_put of batch k+1
+        # overlaps the step on batch k (async transfers), resuming from the
+        # checkpointed cursor
+        from ..datasets.iterator import prefetch_to_device
+        feed = (batches[k % len(batches)] for k in range(int(opt[0]), total))
+        for a, b in prefetch_to_device(feed, size=2):
             params, opt, loss = step_fn(params, opt, a, b)
             losses.append(float(loss))
             if (checkpoint_manager is not None and checkpoint_every > 0

@@ -156,3 +156,19 @@ def test_evaluation_merge():
     ev2.eval(y1, to_outcome_matrix([1, 1], 2))
     ev1.merge(ev2)
     assert ev1.accuracy() == pytest.approx(3 / 4)
+
+
+def test_prefetch_to_device_order_and_placement():
+    """prefetch_to_device must preserve order/count and yield device arrays
+    (double-buffered host->device staging, SURVEY §7 L3)."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+    batches = [(np.full((2, 2), i), np.full((2,), i)) for i in range(5)]
+    out = list(prefetch_to_device(batches, size=3))
+    assert len(out) == 5
+    for i, (a, b) in enumerate(out):
+        assert isinstance(a, jax.Array) and float(a[0, 0]) == i
+        assert float(b[0]) == i
+    assert list(prefetch_to_device([], size=2)) == []
