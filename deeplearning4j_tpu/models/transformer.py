@@ -480,12 +480,15 @@ class TransformerLM:
         loss = lax.pmean(loss, DP)
         return lax.pmean(loss, SP) if sp_axis else loss
 
-    def _grad_sync(self, specs, sp_axis, tp_axis):
+    def _grad_sync(self, specs, sp_axis, tp_axis, include_dp: bool = True):
         """Cross-replica gradient pmean over every axis a param is
-        REPLICATED on (dp+sp always; tp for tp-replicated leaves)."""
+        REPLICATED on (dp+sp always; tp for tp-replicated leaves).
+        ``include_dp=False`` leaves dp to the caller (the ZeRO-1 path
+        reduce-scatters over dp instead)."""
 
         def sync(g, spec):
-            g = lax.pmean(g, DP)
+            if include_dp:
+                g = lax.pmean(g, DP)
             if sp_axis:
                 g = lax.pmean(g, SP)
             sharded_on_tp = any(ax == TP for ax in spec if ax is not None)
@@ -496,7 +499,96 @@ class TransformerLM:
         return lambda grads: jax.tree_util.tree_map(
             sync, grads, specs, is_leaf=lambda x: isinstance(x, P))
 
-    def _build_step(self, tx, loss_of, specs, data_specs):
+    # -- ZeRO-1 weight-update sharding over dp --------------------------
+    #
+    # Instead of pmean-ing full gradients and updating replicated optimizer
+    # state on every dp rank, each rank owns 1/n_dp of every (tp-local)
+    # parameter: gradients reduce-scatter over dp, the transform updates
+    # only the local chunk (optimizer memory / n_dp — the XLA
+    # weight-update-sharding / ZeRO-1 design), and updated params
+    # all-gather back.  State leaves are encoded globally as
+    # (T, n_dp * chunk) with spec P(TP|None, DP): T = n_tp for tp-sharded
+    # params (their chunks differ per tp rank), else 1.
+
+    @staticmethod
+    def _z1_chunk(size: int, n_dp: int) -> int:
+        return -(-size // n_dp)
+
+    def _z1_leaf_is_tp_sharded(self, spec) -> bool:
+        return any(ax == TP for ax in spec if ax is not None)
+
+    def _z1_template_and_specs(self, params, specs):
+        """(zeros template for tx.init, matching PartitionSpecs)."""
+        n_dp, _, n_tp = self._axes()
+
+        def template(p, spec):
+            tp_sharded = self._z1_leaf_is_tp_sharded(spec) and n_tp > 1
+            local_size = int(np.prod(p.shape))
+            if tp_sharded:
+                local_size //= n_tp
+            k = self._z1_chunk(local_size, n_dp)
+            return jnp.zeros((n_tp if tp_sharded else 1, n_dp * k), p.dtype)
+
+        def spec_of(p, spec):
+            tp_sharded = self._z1_leaf_is_tp_sharded(spec) and n_tp > 1
+            return P(TP if tp_sharded else None, DP)
+
+        is_p = lambda x: isinstance(x, P)
+        tmpl = jax.tree_util.tree_map(template, params, specs, is_leaf=is_p)
+        tspec = jax.tree_util.tree_map(spec_of, params, specs, is_leaf=is_p)
+        return tmpl, tspec
+
+    def init_opt_zero1(self, params, tx, specs=None):
+        """Optimizer state with ZeRO-1 layout for
+        ``build_train_step(..., zero1=True)``: every stateful-transform
+        leaf holds only this dp-rank's parameter chunk."""
+        assert self.mesh is not None, "zero1 requires a mesh"
+        if specs is None:
+            specs = (self.finetune_specs() if self._is_finetune_tree(params)
+                     else self._specs())
+        tmpl, tspec = self._z1_template_and_specs(params, specs)
+        state = (jnp.zeros((), jnp.int32), tx.init(tmpl))
+        spec_fn = tx.state_spec or (lambda _: ())
+        return self.place(state, (P(), spec_fn(tspec)))
+
+    def _z1_state_specs(self, specs):
+        """ZeRO-1 state PartitionSpecs derivable from param specs alone
+        (the step builder has no params in hand)."""
+        n_tp = self._axes()[2]
+
+        def spec_of(spec):
+            tp_sharded = self._z1_leaf_is_tp_sharded(spec) and n_tp > 1
+            return P(TP if tp_sharded else None, DP)
+
+        return jax.tree_util.tree_map(
+            spec_of, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def _z1_scatter_gather(self):
+        """(scatter grads -> local chunks, slice params -> local chunks,
+        gather updated chunks -> full params) closures for local_step."""
+        n_dp = self._axes()[0]
+
+        def scatter(g):
+            flat = g.reshape(-1).astype(jnp.float32)
+            k = self._z1_chunk(flat.size, n_dp)
+            flat = jnp.pad(flat, (0, n_dp * k - flat.size))
+            return lax.psum_scatter(flat, DP, scatter_dimension=0,
+                                    tiled=True) / n_dp
+
+        def pslice(p):
+            flat = p.reshape(-1)
+            k = self._z1_chunk(flat.size, n_dp)
+            flat = jnp.pad(flat, (0, n_dp * k - flat.size))
+            my = lax.axis_index(DP)
+            return lax.dynamic_slice(flat, (my * k,), (k,))
+
+        def gather(chunk, p):
+            full = lax.all_gather(chunk, DP, tiled=True)
+            return full[:int(np.prod(p.shape))].reshape(p.shape).astype(p.dtype)
+
+        return scatter, pslice, gather
+
+    def _build_step(self, tx, loss_of, specs, data_specs, zero1: bool = False):
         """Shared step builder: ``loss_of(tree, *data, axes)`` differs per
         objective; everything else (grad, cross-replica sync, transform
         chain, shard_map wrapper) is identical.  Replaces the reference's
@@ -505,6 +597,7 @@ class TransformerLM:
         n_dp, n_sp, n_tp = self._axes()
 
         if self.mesh is None:
+            assert not zero1, "zero1 requires a mesh with a dp axis"
             def simple(tree, opt, *data):
                 count, tx_state = opt
                 loss, g = jax.value_and_grad(
@@ -514,21 +607,47 @@ class TransformerLM:
                 return tree, (count + 1, tx_state), loss
             return jax.jit(simple, donate_argnums=(0, 1))
 
-        opt_spec = self.opt_specs(tx, specs)
         sp_axis = SP if n_sp > 1 else None
         tp_axis = TP if n_tp > 1 else None
-        sync = self._grad_sync(specs, sp_axis, tp_axis)
         axes = dict(n_sp=n_sp, sp_axis=sp_axis, tp_axis=tp_axis)
 
-        def local_step(tree, opt, *data):
-            count, tx_state = opt
-            loss, grads = jax.value_and_grad(
-                lambda t: loss_of(t, *data, axes=axes))(tree)
-            loss = self._loss_reduce(loss, sp_axis)
-            grads = sync(grads)
-            updates, tx_state = tx.update(grads, tx_state, tree, count)
-            tree = apply_updates(tree, updates)
-            return tree, (count + 1, tx_state), loss
+        if zero1:
+            assert n_dp > 1, "zero1 needs a dp axis to shard state over"
+            spec_fn = tx.state_spec or (lambda _: ())
+            opt_spec = (P(), spec_fn(self._z1_state_specs(specs)))
+            # dp is handled by reduce-scatter below; only the replication
+            # axes (sp, and tp for tp-replicated leaves) pmean here
+            sync = self._grad_sync(specs, sp_axis, tp_axis, include_dp=False)
+            scatter, pslice, gather = self._z1_scatter_gather()
+            tmap = jax.tree_util.tree_map
+
+            def local_step(tree, opt, *data):
+                count, tx_state = opt
+                loss, grads = jax.value_and_grad(
+                    lambda t: loss_of(t, *data, axes=axes))(tree)
+                loss = self._loss_reduce(loss, sp_axis)
+                grads = sync(grads)
+                gch = tmap(scatter, grads)
+                pch = tmap(pslice, tree)
+                st = tmap(lambda s: s[0], tx_state)     # (1, k) -> (k,)
+                updates, st = tx.update(gch, st, pch, count)
+                tx_state = tmap(lambda s: s[None], st)
+                pch = apply_updates(pch, updates)
+                tree = tmap(gather, pch, tree)
+                return tree, (count + 1, tx_state), loss
+        else:
+            opt_spec = self.opt_specs(tx, specs)
+            sync = self._grad_sync(specs, sp_axis, tp_axis)
+
+            def local_step(tree, opt, *data):
+                count, tx_state = opt
+                loss, grads = jax.value_and_grad(
+                    lambda t: loss_of(t, *data, axes=axes))(tree)
+                loss = self._loss_reduce(loss, sp_axis)
+                grads = sync(grads)
+                updates, tx_state = tx.update(grads, tx_state, tree, count)
+                tree = apply_updates(tree, updates)
+                return tree, (count + 1, tx_state), loss
 
         smapped = shard_map(
             local_step, mesh=self.mesh,
@@ -538,11 +657,12 @@ class TransformerLM:
         )
         return jax.jit(smapped, donate_argnums=(0, 1))
 
-    def build_train_step(self, tx=None, lr: float = 1e-3):
+    def build_train_step(self, tx=None, lr: float = 1e-3, zero1: bool = False):
         """LM train step over any ``GradientTransform`` (default: the
         reference's SGD+momentum).  Returns
         ``step(params, opt, tokens, targets) -> (params, opt, loss)`` where
-        ``opt = (step_count, tx_state)``."""
+        ``opt = (step_count, tx_state)``.  ``zero1=True`` shards optimizer
+        state over dp (pair with ``init_opt_zero1``)."""
         cfg = self.cfg
         tx = tx if tx is not None else self._default_tx(lr)
 
@@ -550,7 +670,7 @@ class TransformerLM:
             return lm_loss_local(params, tokens, targets, cfg, **axes)
 
         return self._build_step(tx, loss_of, self._specs(),
-                                (P(DP, SP), P(DP, SP)))
+                                (P(DP, SP), P(DP, SP)), zero1=zero1)
 
     # -- BERT-style sequence-classification fine-tune -------------------
     def init_finetune(self, key, n_classes: int, params=None):
