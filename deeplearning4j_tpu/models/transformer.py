@@ -683,10 +683,12 @@ class TransformerLM:
     def finetune_specs(self):
         return {"backbone": self._specs(), "head": cls_head_specs()}
 
-    def build_finetune_step(self, tx=None, lr: float = 2e-5):
+    def build_finetune_step(self, tx=None, lr: float = 2e-5,
+                            zero1: bool = False):
         """Classifier fine-tune step (north star: BERT-base fine-tune).
         ``step(tree, opt, tokens, labels) -> (tree, opt, loss)`` with
-        ``tree = {"backbone": ..., "head": ...}``."""
+        ``tree = {"backbone": ..., "head": ...}``.  ``zero1=True`` shards
+        optimizer state over dp (pair with ``init_opt_zero1``)."""
         cfg = self.cfg
         tx = tx if tx is not None else self._default_tx(lr)
 
@@ -695,7 +697,7 @@ class TransformerLM:
                                   labels, cfg, **axes)
 
         return self._build_step(tx, loss_of, self.finetune_specs(),
-                                (P(DP, SP), P(DP)))
+                                (P(DP, SP), P(DP)), zero1=zero1)
 
     def fit(self, params, opt, batches, *, tx=None, lr: float = 1e-3,
             epochs: int = 1, finetune: bool = False,

@@ -330,3 +330,33 @@ def test_zero1_step_matches_replicated_step():
         shard = next(iter(x.addressable_shards))
         assert shard.data.shape[1] * 2 == x.shape[1]  # dp=2 sharding
     assert n_state >= 2 * n_params  # mu+nu cover all params (plus padding)
+
+
+def test_zero1_finetune_matches_replicated():
+    """ZeRO-1 also composes with the {"backbone", "head"} fine-tune tree."""
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    cfg = tiny_cfg(causal=False)
+    tokens = jax.random.randint(jax.random.key(5), (8, 16), 0, cfg.vocab_size)
+    labels = jnp.any(tokens == 7, axis=1).astype(jnp.int32)
+
+    mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    model = TransformerLM(cfg, mesh=mesh)
+    t_init = TransformerLM(cfg).init_finetune(jax.random.key(1), 2)
+    copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+
+    t0 = model.place(copy(t_init), model.finetune_specs())
+    o0 = model.init_opt(t0, T.adamw(0.01))
+    t0, o0, loss0 = model.build_finetune_step(T.adamw(0.01))(t0, o0, tokens, labels)
+
+    t1 = model.place(copy(t_init), model.finetune_specs())
+    o1 = model.init_opt_zero1(t1, T.adamw(0.01))
+    t1, o1, loss1 = model.build_finetune_step(T.adamw(0.01), zero1=True)(
+        t1, o1, tokens, labels)
+
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(t1["head"]["w_cls"]),
+                               np.asarray(t0["head"]["w_cls"]), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(t1["backbone"]["layers"][0]["w1"]),
+                               np.asarray(t0["backbone"]["layers"][0]["w1"]),
+                               atol=2e-4)
