@@ -200,11 +200,15 @@ class PipelinedTransformerLM(TransformerLM):
         return pipeline_param_specs(self.cfg)
 
 
-    def _grad_sync(self, specs, sp_axis, tp_axis):
+    def _grad_sync(self, specs, sp_axis, tp_axis, include_dp: bool = True):
         """dp/sp replicas hold full per-shard grads -> pmean; pp holds
         PARTIAL contributions on pp-replicated leaves -> psum (stage-sharded
-        leaves already have their full grad locally)."""
-        base = super()._grad_sync(specs, sp_axis, tp_axis)
+        leaves already have their full grad locally).
+
+        Note: ZeRO-1 (``include_dp=False`` callers) is not offered on the
+        pipelined class — pp-stage-sharded state would additionally need
+        P(pp, dp) layouts; ``build_train_step`` here takes no ``zero1``."""
+        base = super()._grad_sync(specs, sp_axis, tp_axis, include_dp)
 
         def sync(grads):
             grads = base(grads)
