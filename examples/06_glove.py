@@ -1,0 +1,49 @@
+"""Train GloVe embeddings from a co-occurrence matrix.
+
+The reference's second embedding family (``models/glove/Glove.java:42`` +
+``CoOccurrences.java``): accumulate windowed co-occurrence counts (native
+C++ fast path when built, Python otherwise), then AdaGrad weighted
+least squares on the log counts.
+
+Run:  python examples/06_glove.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from deeplearning4j_tpu.text.glove import Glove
+
+CORPUS = [
+    "the apple is a sweet fruit",
+    "banana is a yellow fruit and the banana is sweet",
+    "orange fruit is sweet and orange is juicy",
+    "apple and banana and orange are fruit",
+    "fruit salad has apple banana orange",
+    "the car drives on the road",
+    "a truck is a big car on the road",
+    "the bus drives people on the road",
+    "car truck and bus are vehicles on the road",
+    "vehicles like car and bus drive fast",
+] * 8
+
+
+def main():
+    glove = Glove(CORPUS, layer_size=32, window=5, iterations=40,
+                  min_word_frequency=3, seed=11)
+    glove.fit()
+    print(f"final loss: {glove.losses[-1]:.4f}")
+
+    within = glove.similarity("apple", "banana")
+    cross = glove.similarity("apple", "road")
+    print(f"sim(apple, banana) = {within:.3f}  (same topic)")
+    print(f"sim(apple, road)   = {cross:.3f}  (cross topic)")
+    assert within > cross, "within-topic similarity should beat cross-topic"
+
+
+if __name__ == "__main__":
+    main()
