@@ -76,6 +76,24 @@ def dryrun_multichip(n_devices: int) -> None:
     params, _, loss = step(params, opt, tokens, targets)
     loss = float(loss)
     assert jnp.isfinite(loss), f"non-finite loss {loss}"
+
+    # second leg: ZeRO-1 weight-update sharding.  zero1 is a non-pp layout,
+    # so fold pp into dp (same device count) for this leg.
+    z1 = ""
+    if sizes["dp"] * sizes["pp"] > 1:
+        from .mesh import MeshSpec
+        z1_spec = MeshSpec(dp=sizes["dp"] * sizes["pp"], sp=sizes["sp"],
+                           tp=sizes["tp"], pp=1, ep=1)
+        z1_mesh = make_mesh(z1_spec, devices=jax.devices()[:n_devices])
+        z1_model = TransformerLM(cfg, mesh=z1_mesh)
+        p1 = z1_model.place(z1_model.init(jax.random.key(0)))
+        o1 = z1_model.init_opt_zero1(p1, tx)
+        z1_step = z1_model.build_train_step(tx, zero1=True)
+        _, _, z1_loss = z1_step(p1, o1, tokens, targets)
+        z1_loss = float(z1_loss)
+        assert jnp.isfinite(z1_loss), f"non-finite zero1 loss {z1_loss}"
+        z1 = f" zero1[dp{z1_spec.dp}]_loss={z1_loss:.4f}"
+
     print(f"dryrun_multichip OK: mesh={dict(sizes)} devices={n_devices} "
           f"batch={batch} seq={seq} n_micro={n_micro if sizes['pp'] > 1 else 0} "
-          f"loss={loss:.4f}")
+          f"loss={loss:.4f}{z1}")
