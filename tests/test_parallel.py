@@ -62,7 +62,10 @@ def test_collectives_via_shard_map():
 
 
 def test_ring_shift():
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map           # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     mesh = local_mesh()
     fm = shard_map(lambda x: coll.ring_shift(x, DP, 8, 1), mesh=mesh,
