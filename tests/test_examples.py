@@ -29,3 +29,18 @@ def test_example_iris_mlp():
 def test_example_distributed_wordcount():
     out = _run("04_distributed_wordcount.py")
     assert "top words:" in out
+
+
+def test_example_bert_finetune_sharded():
+    out = _run("03_bert_finetune_sharded.py", timeout=420.0)
+    assert "loss:" in out
+
+
+def test_example_lstm_textgen():
+    out = _run("05_lstm_textgen.py", timeout=420.0)
+    assert "beam search" in out
+
+
+def test_example_glove():
+    out = _run("06_glove.py", timeout=420.0)
+    assert "sim(apple, banana)" in out
