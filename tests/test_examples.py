@@ -44,3 +44,8 @@ def test_example_lstm_textgen():
 def test_example_glove():
     out = _run("06_glove.py", timeout=420.0)
     assert "sim(apple, banana)" in out
+
+
+def test_example_driver_checkpoint():
+    out = _run("07_driver_checkpoint.py", timeout=420.0)
+    assert "resumed" in out
