@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -203,11 +204,8 @@ class PipelinedTransformerLM(TransformerLM):
     def _grad_sync(self, specs, sp_axis, tp_axis, include_dp: bool = True):
         """dp/sp replicas hold full per-shard grads -> pmean; pp holds
         PARTIAL contributions on pp-replicated leaves -> psum (stage-sharded
-        leaves already have their full grad locally).
-
-        Note: ZeRO-1 (``include_dp=False`` callers) is not offered on the
-        pipelined class — pp-stage-sharded state would additionally need
-        P(pp, dp) layouts; ``build_train_step`` here takes no ``zero1``."""
+        leaves already have their full grad locally).  ``include_dp=False``
+        is the ZeRO-1 path: dp handled by the caller's reduce-scatter."""
         base = super()._grad_sync(specs, sp_axis, tp_axis, include_dp)
 
         def sync(grads):
@@ -228,10 +226,73 @@ class PipelinedTransformerLM(TransformerLM):
         recovers it, then the usual dp/sp pmean applies."""
         return super()._loss_reduce(lax.psum(loss, PP), sp_axis)
 
-    def build_train_step(self, tx=None, lr: float = 1e-3):
+    # -- ZeRO-1 over dp, composed with pp -------------------------------
+    #
+    # Stage-sharded leaves (the stacked ``layers`` subtree, spec
+    # ``P(PP, ...)``) hold a DIFFERENT local chunk per pp rank, exactly as
+    # tp-sharded leaves do per tp rank — so their dp-sharded optimizer
+    # state grows a pp row dimension: state leaves are encoded globally as
+    # ``(rows, n_dp * k)`` with ``rows = n_pp·[n_tp]`` and spec
+    # ``P((PP[, TP]), DP)``.  Inside shard_map every rank still sees a
+    # ``(1, k)`` local leaf, so the parent's scatter/update/gather local
+    # step needs no change at all.
+
+    def _decay_mask(self, tree):
+        """Stacking grafts a leading layer axis onto every per-layer leaf,
+        so the ndim >= 2 weight-class default misfires there (a (D,) LN
+        scale becomes (L, D)): stacked leaves are weight-class iff their
+        UNstacked form is, i.e. ndim >= 3."""
+        def mask(path, w):
+            stacked = any(getattr(k, "key", None) == "layers" for k in path)
+            return w.ndim >= (3 if stacked else 2)
+        return jax.tree_util.tree_map_with_path(mask, tree)
+
+    def _z1_leaf_is_pp_sharded(self, spec) -> bool:
+        return any(ax == PP for ax in spec if ax is not None)
+
+    def _z1_row_layout(self, spec):
+        """(row count multiplier axes, row PartitionSpec entry) for a leaf."""
+        _, _, n_tp = self._axes()
+        axes = []
+        if self._z1_leaf_is_pp_sharded(spec):
+            axes.append((PP, self.n_pp))
+        if self._z1_leaf_is_tp_sharded(spec) and n_tp > 1:
+            axes.append((TP, n_tp))
+        names = tuple(a for a, _ in axes)
+        row_spec = names if len(names) > 1 else (names[0] if names else None)
+        rows = 1
+        for _, n in axes:
+            rows *= n
+        return rows, row_spec
+
+    def _z1_template_and_specs(self, params, specs):
+        n_dp = self._axes()[0]
+
+        def template(p, spec):
+            rows, _ = self._z1_row_layout(spec)
+            local_size = int(np.prod(p.shape)) // rows
+            k = self._z1_chunk(local_size, n_dp)
+            return jnp.zeros((rows, n_dp * k), p.dtype)
+
+        def spec_of(p, spec):
+            return P(self._z1_row_layout(spec)[1], DP)
+
+        is_p = lambda x: isinstance(x, P)
+        tmpl = jax.tree_util.tree_map(template, params, specs, is_leaf=is_p)
+        tspec = jax.tree_util.tree_map(spec_of, params, specs, is_leaf=is_p)
+        return tmpl, tspec
+
+    def _z1_state_specs(self, specs):
+        return jax.tree_util.tree_map(
+            lambda spec: P(self._z1_row_layout(spec)[1], DP), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def build_train_step(self, tx=None, lr: float = 1e-3, zero1: bool = False):
         """``step(params, opt, tokens, targets) -> (params, opt, loss)``
         with the layer stack pipelined over pp (shared ``_build_step``
-        wiring; only the loss fn, specs, and reductions differ)."""
+        wiring; only the loss fn, specs, and reductions differ).
+        ``zero1=True`` shards optimizer state over dp, including the
+        pp-stage-sharded leaves (pair with ``init_opt_zero1``)."""
         cfg = self.cfg
         tx = tx if tx is not None else self._default_tx(lr)
         n_pp, n_micro = self.n_pp, self.n_micro
@@ -241,7 +302,7 @@ class PipelinedTransformerLM(TransformerLM):
                                            n_pp=n_pp, n_micro=n_micro, **axes)
 
         return self._build_step(tx, loss_of, self._specs(),
-                                (P(DP, SP), P(DP, SP)))
+                                (P(DP, SP), P(DP, SP)), zero1=zero1)
 
     def _pipeline_axes(self):
         s = self.mesh.shape

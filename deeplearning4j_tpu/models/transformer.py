@@ -331,6 +331,12 @@ def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
         # stream instead of silently falling back to full (B*T, V) logits
         while n_tok % chunk:
             chunk -= 1
+        # a near-prime token count can drive the divisor search down to a
+        # tiny chunk — thousands of sequential (chunk, V) matmuls in the
+        # scan is far worse than one full-logits pass; if no divisor lands
+        # within 4x of the configured chunk, fall back to the full pass
+        if chunk < cfg.xent_chunk // 4:
+            chunk = 0
 
     if chunk and 1 < chunk < n_tok:
         body_fn = jax.checkpoint(token_xent)
@@ -447,6 +453,12 @@ class TransformerLM:
 
     def _is_finetune_tree(self, tree):
         return isinstance(tree, dict) and set(tree.keys()) == {"backbone", "head"}
+
+    def _decay_mask(self, tree):
+        """Bool pytree naming the weight-class (decayed) leaves of ``tree``.
+        None = the transforms' ndim >= 2 default, which is correct for this
+        class's canonical layout; layout-changing subclasses override."""
+        return None
 
     def _specs(self):
         """Param-tree PartitionSpecs for this model's layer layout
@@ -593,6 +605,7 @@ class TransformerLM:
         objective; everything else (grad, cross-replica sync, transform
         chain, shard_map wrapper) is identical.  Replaces the reference's
         ``Solver``→``BaseOptimizer.optimize`` dispatch for the flagship."""
+        from ..optimize import transforms as Tmod
         from ..optimize.transforms import apply_updates
         n_dp, n_sp, n_tp = self._axes()
 
@@ -602,7 +615,8 @@ class TransformerLM:
                 count, tx_state = opt
                 loss, g = jax.value_and_grad(
                     lambda t: loss_of(t, *data, axes={}))(tree)
-                updates, tx_state = tx.update(g, tx_state, tree, count)
+                with Tmod.decay_mask_override(self._decay_mask(tree)):
+                    updates, tx_state = tx.update(g, tx_state, tree, count)
                 tree = apply_updates(tree, updates)
                 return tree, (count + 1, tx_state), loss
             return jax.jit(simple, donate_argnums=(0, 1))
@@ -630,7 +644,14 @@ class TransformerLM:
                 gch = tmap(scatter, grads)
                 pch = tmap(pslice, tree)
                 st = tmap(lambda s: s[0], tx_state)     # (1, k) -> (k,)
-                updates, st = tx.update(gch, st, pch, count)
+                # chunking flattened every param to 1-D, so the ndim >= 2
+                # decay default would silently drop weight decay — name the
+                # weight-class leaves from the UNchunked tree instead
+                mask = self._decay_mask(tree)
+                if mask is None:
+                    mask = Tmod.decay_leaf_mask(tree)
+                with Tmod.decay_mask_override(mask):
+                    updates, st = tx.update(gch, st, pch, count)
                 tx_state = tmap(lambda s: s[None], st)
                 pch = apply_updates(pch, updates)
                 tree = tmap(gather, pch, tree)
@@ -645,7 +666,8 @@ class TransformerLM:
                     lambda t: loss_of(t, *data, axes=axes))(tree)
                 loss = self._loss_reduce(loss, sp_axis)
                 grads = sync(grads)
-                updates, tx_state = tx.update(grads, tx_state, tree, count)
+                with Tmod.decay_mask_override(self._decay_mask(tree)):
+                    updates, tx_state = tx.update(grads, tx_state, tree, count)
                 tree = apply_updates(tree, updates)
                 return tree, (count + 1, tx_state), loss
 

@@ -40,6 +40,43 @@ def _empty_spec(param_specs):
     return ()
 
 
+# --------------------------------------------------------------------- decay mask
+#
+# "Which leaves get weight decay" defaults to the ndim >= 2 heuristic
+# (weight matrices yes, biases/layernorms no).  That heuristic is a
+# statement about the CANONICAL param layout — trainers that re-lay params
+# out break it: the pipelined model stacks per-layer leaves (an (D,) LN
+# scale becomes (L, D), ndim 2) and the ZeRO-1 step flattens every param
+# to a 1-D chunk.  Such trainers wrap their tx.update call in
+# ``decay_mask_override`` with a bool pytree (matching the params tree
+# they pass) saying which leaves are weight-class.  The context is read at
+# trace time, so it composes with jit/shard_map.
+
+from contextlib import contextmanager
+
+_DECAY_MASK_STACK: list = []
+
+
+@contextmanager
+def decay_mask_override(mask):
+    """Override the decay-leaf choice for tx.update calls traced inside
+    this context.  ``mask``: bool pytree matching the params tree handed
+    to update (None = keep the ndim >= 2 default)."""
+    _DECAY_MASK_STACK.append(mask)
+    try:
+        yield
+    finally:
+        _DECAY_MASK_STACK.pop()
+
+
+def decay_leaf_mask(params):
+    """Effective decay mask for ``params``: the innermost active override,
+    else the ndim >= 2 heuristic."""
+    if _DECAY_MASK_STACK and _DECAY_MASK_STACK[-1] is not None:
+        return _DECAY_MASK_STACK[-1]
+    return tree_map(lambda w: jnp.ndim(w) >= 2, params)
+
+
 def chain(*transforms: GradientTransform) -> GradientTransform:
     def init(params):
         return tuple(t.init(params) for t in transforms)
@@ -170,15 +207,16 @@ def scale_by_adam(b1: float = 0.9, b2: float = 0.999,
 
 
 def add_decayed_weights(wd: float) -> GradientTransform:
-    """Decoupled weight decay (AdamW): updates += wd * w on weight matrices
-    (ndim >= 2) only — biases/layernorms stay undecayed."""
+    """Decoupled weight decay (AdamW): updates += wd * w on weight-class
+    leaves only (``decay_leaf_mask``: ndim >= 2 unless overridden) —
+    biases/layernorms stay undecayed."""
 
     def update(grads, s, params=None, iteration=0):
         if params is None or wd == 0.0:
             return grads, s
         return tree_map(
-            lambda g, w: g + wd * w.astype(g.dtype) if w.ndim >= 2 else g,
-            grads, params), s
+            lambda g, w, m: g + wd * w.astype(g.dtype) if m else g,
+            grads, params, decay_leaf_mask(params)), s
 
     return GradientTransform(lambda p: (), update)
 
@@ -235,17 +273,21 @@ def weight_decay(l2: float) -> GradientTransform:
 
 
 def l2_grad(l2: float, grads, params):
-    """g + l2*w over the same (ndim >= 2) leaves weight_decay touches — the
-    single source of truth for 'which leaves get decayed'."""
-    return tree_map(lambda g, w: g + l2 * w if w.ndim >= 2 else g, grads, params)
+    """g + l2*w over the same leaves weight_decay touches
+    (``decay_leaf_mask``) — the single source of truth for 'which leaves
+    get decayed'."""
+    return tree_map(lambda g, w, m: g + l2 * w if m else g,
+                    grads, params, decay_leaf_mask(params))
 
 
 def l2_penalty(l2: float, params) -> jnp.ndarray:
-    """0.5*l2*||W||^2 over the same (ndim >= 2) leaves weight_decay touches —
-    use when an objective VALUE must stay consistent with the decayed
-    direction (line-search probes)."""
+    """0.5*l2*||W||^2 over the same leaves weight_decay touches
+    (``decay_leaf_mask``) — use when an objective VALUE must stay
+    consistent with the decayed direction (line-search probes)."""
     leaves = [0.5 * l2 * jnp.sum(w * w)
-              for w in jax.tree_util.tree_leaves(params) if w.ndim >= 2]
+              for w, m in zip(jax.tree_util.tree_leaves(params),
+                              jax.tree_util.tree_leaves(decay_leaf_mask(params)))
+              if m]
     return sum(leaves) if leaves else jnp.zeros(())
 
 

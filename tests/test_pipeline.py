@@ -191,3 +191,52 @@ def test_layers_not_divisible_by_pp_rejected():
                      devices=jax.devices()[:8])
     with pytest.raises(AssertionError):
         PipelinedTransformerLM(cfg, mesh)
+
+
+def test_pp2_dp2_zero1_matches_replicated_pipelined_step():
+    """ZeRO-1 composed with pp: dp-sharded optimizer state with a pp row
+    dimension on stage-sharded leaves computes the SAME training math as
+    the replicated pipelined step — and really is 1/n_dp per (pp, dp)
+    rank."""
+    cfg = _cfg(n_heads=4, n_layers=2)
+    tokens, targets = _data(cfg, batch=8, seq=16)
+    mesh = make_mesh(MeshSpec(dp=2, pp=2, sp=1, tp=2),
+                     devices=jax.devices()[:8])
+    copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+    p_init = PipelinedTransformerLM(cfg, mesh, n_micro=2).init(
+        jax.random.key(0))
+
+    def tx():
+        return T.adamw(0.01)
+
+    # replicated-state pipelined baseline
+    model0 = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    p0 = model0.place(copy(p_init))
+    o0 = model0.init_opt(p0, tx())
+    step0 = model0.build_train_step(tx())
+    for _ in range(2):
+        p0, o0, loss0 = step0(p0, o0, tokens, targets)
+
+    # zero1 pipelined
+    model1 = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    p1 = model1.place(copy(p_init))
+    o1 = model1.init_opt_zero1(p1, tx())
+    step1 = model1.build_train_step(tx(), zero1=True)
+    for _ in range(2):
+        p1, o1, loss1 = step1(p1, o1, tokens, targets)
+
+    np.testing.assert_allclose(float(loss1), float(loss0), rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+    # every adam moment leaf's addressable shard covers 1/dp of its last
+    # dim, and stage-sharded leaves carry the pp row dimension
+    stacked_rows = {2}  # n_pp
+    mu_leaves = jax.tree.leaves(o1[1])
+    assert any(x.shape[0] in stacked_rows or x.shape[0] == 4  # pp, pp*tp
+               for x in mu_leaves if x.ndim == 2)
+    for x in mu_leaves:
+        if x.ndim != 2:
+            continue
+        shard = next(iter(x.addressable_shards))
+        assert shard.data.shape[1] * 2 == x.shape[1]  # dp=2 sharding

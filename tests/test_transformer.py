@@ -360,3 +360,35 @@ def test_zero1_finetune_matches_replicated():
     np.testing.assert_allclose(np.asarray(t1["backbone"]["layers"][0]["w1"]),
                                np.asarray(t0["backbone"]["layers"][0]["w1"]),
                                atol=2e-4)
+
+
+def test_zero1_applies_weight_decay_to_weight_leaves():
+    """Regression: chunking flattens params to 1-D, which used to make the
+    ndim >= 2 decay heuristic silently drop AdamW weight decay in the
+    ZeRO-1 step.  Heavy decay (lr 0.3, wd 0.9) makes any drop blow far
+    past tolerance against the replicated step."""
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    cfg = tiny_cfg(causal=False)
+    tokens = jax.random.randint(jax.random.key(5), (8, 16), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mesh = make_mesh(MeshSpec(dp=2, sp=1, tp=1), devices=jax.devices()[:2])
+    model = TransformerLM(cfg, mesh=mesh)
+    p_init = TransformerLM(cfg).init(jax.random.key(1))
+    copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+
+    def tx():
+        return T.adamw(0.3, weight_decay=0.9)
+
+    p0 = model.place(copy(p_init))
+    o0 = model.init_opt(p0, tx())
+    p0, _, _ = model.build_train_step(tx())(p0, o0, tokens, targets)
+
+    p1 = model.place(copy(p_init))
+    o1 = model.init_opt_zero1(p1, tx())
+    p1, _, _ = model.build_train_step(tx(), zero1=True)(p1, o1, tokens, targets)
+
+    # decay moved the embedding by ~lr*wd*|w| >> atol; a dropped decay
+    # cannot pass this comparison
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
