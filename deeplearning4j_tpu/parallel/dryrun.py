@@ -77,23 +77,47 @@ def dryrun_multichip(n_devices: int) -> None:
     loss = float(loss)
     assert jnp.isfinite(loss), f"non-finite loss {loss}"
 
-    # second leg: ZeRO-1 weight-update sharding.  zero1 is a non-pp layout,
-    # so fold pp into dp (same device count) for this leg.
+    # second leg: ZeRO-1 weight-update sharding ON the same mesh — when pp
+    # is live this exercises the pipelined ZeRO-1 path (dp-sharded state
+    # with a pp row dimension on stage-sharded leaves).
     z1 = ""
-    if sizes["dp"] * sizes["pp"] > 1:
-        from .mesh import MeshSpec
-        z1_spec = MeshSpec(dp=sizes["dp"] * sizes["pp"], sp=sizes["sp"],
-                           tp=sizes["tp"], pp=1, ep=1)
-        z1_mesh = make_mesh(z1_spec, devices=jax.devices()[:n_devices])
-        z1_model = TransformerLM(cfg, mesh=z1_mesh)
-        p1 = z1_model.place(z1_model.init(jax.random.key(0)))
-        o1 = z1_model.init_opt_zero1(p1, tx)
-        z1_step = z1_model.build_train_step(tx, zero1=True)
+    if sizes["dp"] > 1:
+        p1 = model.place(model.init(jax.random.key(0)))  # step donated params
+        o1 = model.init_opt_zero1(p1, tx)
+        z1_step = model.build_train_step(tx, zero1=True)
         _, _, z1_loss = z1_step(p1, o1, tokens, targets)
         z1_loss = float(z1_loss)
         assert jnp.isfinite(z1_loss), f"non-finite zero1 loss {z1_loss}"
-        z1 = f" zero1[dp{z1_spec.dp}]_loss={z1_loss:.4f}"
+        kind = "pp-pipelined" if sizes["pp"] > 1 else "plain"
+        z1 = f" zero1[{kind},dp{sizes['dp']}]_loss={z1_loss:.4f}"
+
+    # third leg: cross-device ring attention.  The round-robin factoring
+    # gives sp=1 at n=8 (dp2·pp2·tp2), so ring attention's ppermute path
+    # would only ever run over sp>1 at n>=16.  Fold pp into sp (same device
+    # count) so the driver-recorded dryrun exercises the ring at n=8 too.
+    sp = ""
+    if sizes["sp"] == 1 and sizes["pp"] > 1:
+        from .mesh import MeshSpec
+        sp_spec = MeshSpec(dp=sizes["dp"], sp=sizes["pp"] * sizes["sp"],
+                           tp=sizes["tp"], pp=1, ep=1)
+        sp_mesh = make_mesh(sp_spec, devices=jax.devices()[:n_devices])
+        sp_seq = 8 * sp_spec.sp
+        sp_cfg = TransformerConfig(
+            vocab_size=128, d_model=8 * n_heads, n_heads=n_heads,
+            n_layers=2, d_ff=64, max_len=sp_seq, causal=True,
+            dtype=jnp.float32, remat=True,
+        )
+        sp_model = TransformerLM(sp_cfg, mesh=sp_mesh)
+        p2 = sp_model.place(sp_model.init(jax.random.key(0)))
+        o2 = sp_model.init_opt(p2, tx)
+        sp_tokens = jax.random.randint(
+            jax.random.key(2), (sizes["dp"] * 2, sp_seq), 0, sp_cfg.vocab_size)
+        sp_step = sp_model.build_train_step(tx)
+        _, _, sp_loss = sp_step(p2, o2, sp_tokens, jnp.roll(sp_tokens, -1, axis=1))
+        sp_loss = float(sp_loss)
+        assert jnp.isfinite(sp_loss), f"non-finite sp loss {sp_loss}"
+        sp = f" ring[dp{sp_spec.dp}·tp{sp_spec.tp}·sp{sp_spec.sp}]_loss={sp_loss:.4f}"
 
     print(f"dryrun_multichip OK: mesh={dict(sizes)} devices={n_devices} "
           f"batch={batch} seq={seq} n_micro={n_micro if sizes['pp'] > 1 else 0} "
-          f"loss={loss:.4f}{z1}")
+          f"loss={loss:.4f}{z1}{sp}")
