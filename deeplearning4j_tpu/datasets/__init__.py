@@ -15,6 +15,13 @@ from .fetchers import (
     LFWDataFetcher,
     MnistDataFetcher,
 )
+from .svmlight import (
+    SVMLightDataFetcher,
+    SVMLightDataSetIterator,
+    load_svmlight,
+    parse_svmlight_line,
+    save_svmlight,
+)
 from .iterator import (
     BaseDatasetIterator,
     CSVDataSetIterator,
@@ -40,4 +47,6 @@ __all__ = [
     "MnistDataSetIterator", "MovingWindowDataSetIterator",
     "MultipleEpochsIterator", "ReconstructionDataSetIterator",
     "SamplingDataSetIterator", "TestDataSetIterator",
+    "SVMLightDataFetcher", "SVMLightDataSetIterator", "load_svmlight",
+    "parse_svmlight_line", "save_svmlight",
 ]
