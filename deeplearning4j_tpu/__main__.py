@@ -118,6 +118,18 @@ def _cmd_provision(args) -> int:
     prov = PodSliceProvisioner(PodSliceSpec(
         name=args.name, accelerator_type=args.accelerator_type,
         zone=args.zone, spot=args.spot))
+    if args.kill:
+        rec = prov.teardown(dry_run=not args.apply)
+        print(json.dumps(rec))
+        return 0
+    if not args.repo_url:
+        raise SystemExit("--repo-url is required unless --kill")
+    if args.apply or args.dry_run_apply:
+        records = prov.apply(args.repo_url, args.train_argv,
+                             dry_run=not args.apply)
+        for rec in records:
+            print(json.dumps(rec))
+        return 0
     if args.out:
         path = prov.write_script(args.out, args.repo_url, args.train_argv)
         print(f"wrote {path}")
@@ -171,14 +183,21 @@ def main(argv=None) -> int:
     d.set_defaults(fn=_cmd_dryrun)
 
     p = sub.add_parser("provision",
-                       help="render a pod-slice create/bootstrap/launch script")
+                       help="render or EXECUTE a pod-slice create/bootstrap/"
+                            "launch sequence (ClusterSetup parity)")
     p.add_argument("--name", default="dl4j-tpu-slice")
     p.add_argument("--accelerator-type", default="v5litepod-64")
     p.add_argument("--zone", default="us-west4-a")
     p.add_argument("--spot", action="store_true")
-    p.add_argument("--repo-url", required=True)
+    p.add_argument("--repo-url", default="")
     p.add_argument("--train-argv", default="-m deeplearning4j_tpu train")
     p.add_argument("--out", help="write the script here instead of stdout")
+    p.add_argument("--apply", action="store_true",
+                   help="actually run gcloud (default is dry-run/render)")
+    p.add_argument("--dry-run-apply", action="store_true",
+                   help="print the apply command sequence without running")
+    p.add_argument("--kill", action="store_true",
+                   help="tear the slice down instead of bringing it up")
     p.set_defaults(fn=_cmd_provision)
 
     ap.add_argument("--platform", default="cpu",

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import shlex
+import subprocess
 from pathlib import Path
 
 __all__ = ["PodSliceSpec", "PodSliceProvisioner"]
@@ -123,6 +124,76 @@ class PodSliceProvisioner:
                 "'http://metadata/computeMetadata/v1/instance/attributes/"
                 "agent-worker-number') "
                 f"python {train_argv}")
+
+    # -- execution (ClusterSetup.java:24 actually provisions) ------------
+
+    def describe_ip_command(self) -> list[str]:
+        s = self.spec
+        return ["gcloud", "compute", "tpus", "tpu-vm", "describe", s.name,
+                f"--zone={s.zone}",
+                "--format=value(networkEndpoints[0].ipAddress)"]
+
+    def apply(self, repo_url: str, train_argv: str, *, dry_run: bool = True,
+              coordinator_host: str | None = None,
+              timeout_s: float = 1800.0) -> list[dict]:
+        """EXECUTE the provisioning sequence — create the slice, bootstrap
+        every host, resolve the coordinator IP, launch everywhere — the way
+        the reference's ``ClusterSetup``/``HostProvisioner`` actually SSH
+        into boxes rather than printing commands.  ``dry_run`` (the
+        default) returns the resolved command list without running
+        anything; pass ``dry_run=False`` where a cloud and ``gcloud``
+        exist.  Returns one ``{"step", "cmd", "rc", "stdout"}`` record per
+        command (``rc`` is None under dry-run); raises on the first
+        failing step, since later steps depend on earlier ones."""
+        records = []
+
+        def run(step: str, cmd: list[str]) -> str:
+            rec = {"step": step, "cmd": cmd, "rc": None, "stdout": ""}
+            records.append(rec)
+            if dry_run:
+                return ""
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+            rec["rc"] = proc.returncode
+            rec["stdout"] = proc.stdout.strip()
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"provision step {step!r} failed rc={proc.returncode}: "
+                    f"{proc.stderr[-500:]}")
+            return rec["stdout"]
+
+        run("create", self.create_command())
+        run("bootstrap", self.ssh_all_command(self.bootstrap_command(repo_url)))
+        coord = coordinator_host or run("resolve_coordinator",
+                                        self.describe_ip_command())
+        if not coord:
+            if dry_run:
+                coord = "$COORD"     # placeholder, as in the rendered script
+            else:
+                # launching a pod against an empty coordinator address hangs
+                # every host in distributed init with no error — fail here
+                raise RuntimeError(
+                    "coordinator IP resolve returned empty (slice endpoint "
+                    "not yet populated?) — refusing to launch")
+        run("launch", self.ssh_all_command(
+            self.launch_command(train_argv, coord)))
+        return records
+
+    def teardown(self, *, dry_run: bool = True,
+                 timeout_s: float = 1800.0) -> dict:
+        """EXECUTE slice deletion (the Kill-side symmetry of ``apply``)."""
+        cmd = self.delete_command()
+        rec = {"step": "delete", "cmd": cmd, "rc": None, "stdout": ""}
+        if not dry_run:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=timeout_s)
+            rec["rc"] = proc.returncode
+            rec["stdout"] = proc.stdout.strip()
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"teardown failed rc={proc.returncode}: "
+                    f"{proc.stderr[-500:]}")
+        return rec
 
     # -- one-file artifact ----------------------------------------------
     def render_script(self, repo_url: str, train_argv: str,

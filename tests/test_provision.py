@@ -129,3 +129,86 @@ def test_driver_wildcard_mesh_uses_all_devices():
 
     d = Driver(loss_fn, T.sgd_lr(1e-2), mesh_spec=MeshSpec(tp=2))
     assert d.mesh.devices.size == len(jax.devices())   # wildcard dp fills
+
+
+def _stub_gcloud(tmp_path):
+    """A fake gcloud on PATH that logs argv and answers `describe` with an
+    IP — lets apply()/teardown() integration-test without a cloud."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    log = tmp_path / "gcloud.log"
+    stub = bindir / "gcloud"
+    stub.write_text(
+        "#!/usr/bin/env bash\n"
+        f'echo "$@" >> {log}\n'
+        'for a in "$@"; do\n'
+        '  if [ "$a" = "describe" ]; then echo 10.1.2.3; fi\n'
+        "done\n")
+    stub.chmod(0o755)
+    return bindir, log
+
+
+def test_apply_dry_run_is_default_and_runs_nothing(tmp_path):
+    prov = PodSliceProvisioner(PodSliceSpec(accelerator_type="v5litepod-8"))
+    records = prov.apply("https://example.com/r.git", "-m deeplearning4j_tpu train")
+    steps = [r["step"] for r in records]
+    assert steps == ["create", "bootstrap", "resolve_coordinator", "launch"]
+    assert all(r["rc"] is None for r in records)     # nothing executed
+
+
+def test_apply_executes_against_stub_gcloud(tmp_path, monkeypatch):
+    """--apply parity with ClusterSetup.java:24: the sequence actually
+    executes (create -> bootstrap -> describe -> launch), the resolved
+    coordinator IP feeds the launch env, and --kill tears down."""
+    bindir, log = _stub_gcloud(tmp_path)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    prov = PodSliceProvisioner(PodSliceSpec(
+        name="s8", accelerator_type="v5litepod-8", zone="us-west4-a"))
+    records = prov.apply("https://example.com/r.git",
+                         "-m deeplearning4j_tpu train", dry_run=False)
+    assert [r["rc"] for r in records] == [0, 0, 0, 0]
+    logged = log.read_text()
+    assert "create s8" in logged and "delete" not in logged
+    assert logged.count("--worker=all") == 2         # bootstrap + launch
+    launch = records[-1]["cmd"][-1]
+    assert "JAX_COORDINATOR_ADDRESS=10.1.2.3:8476" in launch
+
+    rec = prov.teardown(dry_run=False)
+    assert rec["rc"] == 0
+    assert "delete s8" in log.read_text()
+
+
+def test_cli_provision_apply_and_kill_with_stub(tmp_path, monkeypatch):
+    bindir, log = _stub_gcloud(tmp_path)
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "provision",
+         "--name", "sX", "--accelerator-type", "v5litepod-8",
+         "--repo-url", "https://example.com/r.git", "--apply"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "create sX" in log.read_text()
+
+    p = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "provision",
+         "--name", "sX", "--kill", "--apply"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "delete sX" in log.read_text()
+
+
+def test_cli_provision_kill_dry_run_executes_nothing(tmp_path, monkeypatch):
+    """--kill without --apply must only PRINT the delete command."""
+    bindir, log = _stub_gcloud(tmp_path)
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "provision",
+         "--name", "sY", "--kill"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert p.returncode == 0, p.stderr[-800:]
+    assert "delete" in p.stdout and not log.exists()
