@@ -267,10 +267,16 @@ class MultiLayerNetwork:
 
     def _finetune_solver(self, batches: Sequence[DataSet], key, algo) -> None:
         from ..optimize.solvers import Solver  # deferred: avoids import cycle
-        data = DataSet.merge(list(batches))
-        x, y = jnp.asarray(data.features), jnp.asarray(data.labels)
+        # Mini-batch mode: the solver cycles batches across outer iterations
+        # instead of the r4 DataSet.merge of the whole corpus — DEVICE
+        # memory is bounded by one batch (batches stay host-side numpy;
+        # each jitted call transfers only the iteration's batch).  Keeping
+        # shapes uniform (batch_by pads nothing, so the tail batch may
+        # recompile once) bounds compilation at two variants.
+        data = [(np.asarray(b.features), np.asarray(b.labels))
+                for b in batches]
 
-        def objective(params, k):
+        def objective(params, k, x, y):
             return jax.value_and_grad(self.supervised_loss)(params, x, y)
 
         out_conf = self.layers[-1].conf
@@ -282,19 +288,20 @@ class MultiLayerNetwork:
             from ..ops import activations as _act
             from ..ops import losses as _losses
 
-            def predict(params, k):
+            def predict(params, k, x, y):
                 h = jnp.asarray(x)
                 for i, (layer, p) in enumerate(zip(self.layers[:-1], params[:-1])):
                     h = self._preproc(i, layer.activate(p, h))
                 return self.layers[-1].pre_output(params[-1], h)
 
-            def loss_out(z):
+            def loss_out(z, x, y):
                 return _losses.score(out_conf.loss, y,
                                      _act.apply(out_conf.activation, z))
 
             extra = {"damping": self.conf.damping_factor,
                      "gauss_newton": (predict, loss_out)}
-        solver = Solver(out_conf, objective, listeners=self.listeners, **extra)
+        solver = Solver(out_conf, objective, listeners=self.listeners,
+                        batches=data, **extra)
         result = solver.optimize(self.params, key)
         self.params = result.params
         self._score = result.score

@@ -15,6 +15,15 @@ loops around jitted evaluations — per-step hot paths in real training use the
 jitted train step in ``nn.multilayer`` instead.  Curvature products use
 ``jax.jvp`` over ``jax.grad`` (R-operator; replaces the hand-written
 ``MultiLayerNetwork.computeDeltasR/feedForwardR:1415-1487``).
+
+Mini-batch mode: pass ``batches=[(x, y), ...]`` and an objective of
+signature ``(params, key, x, y) -> (loss, grads)``.  Each outer iteration
+cycles to the next batch — line-search probes and curvature products
+within one iteration all use THAT iteration's batch (the stochastic-HF
+contract, Martens §4; the reference's ``StochasticHessianFree`` name says
+the same), so no merged whole-dataset array ever exists and memory is
+bounded by one batch.  The batch arrays are jit arguments: uniform batch
+shapes compile once.
 """
 
 from __future__ import annotations
@@ -94,13 +103,14 @@ class BaseOptimizer:
                  listeners: Sequence[IterationListener] = (),
                  terminations: Sequence[TerminationCondition] = (),
                  transform: tfm.GradientTransform | None = None,
-                 training_evaluator=None):
+                 training_evaluator=None, batches=None):
         self.conf = conf
         self.objective = objective
         self.listeners = list(listeners)
         self.terminations = list(terminations) or [EpsTermination()]
         self.transform = transform if transform is not None else tfm.from_conf(conf)
         self.training_evaluator = training_evaluator
+        self.batches = list(batches) if batches is not None else None
         self._score = float("inf")
         self._jit_obj = jax.jit(objective)
         # Value-only objective for line-search probes (no wasted backward
@@ -110,9 +120,16 @@ class BaseOptimizer:
         if conf.use_regularization and conf.l2 > 0:
             l2 = conf.l2
             self._jit_val = jax.jit(
-                lambda p, k: objective(p, k)[0] + tfm.l2_penalty(l2, p))
+                lambda p, k, *b: objective(p, k, *b)[0] + tfm.l2_penalty(l2, p))
         else:
-            self._jit_val = jax.jit(lambda p, k: objective(p, k)[0])
+            self._jit_val = jax.jit(lambda p, k, *b: objective(p, k, *b)[0])
+
+    def _batch(self, it: int) -> tuple:
+        """The extra jit arguments for iteration ``it``: the next mini-batch
+        in the cycle, or () in whole-objective mode."""
+        if not self.batches:
+            return ()
+        return tuple(self.batches[it % len(self.batches)])
 
     def score(self) -> float:
         return self._score
@@ -136,13 +153,14 @@ class BaseOptimizer:
         for it in range(self.conf.num_iterations):
             state["iteration"] = it
             key, sub = jax.random.split(key)
-            loss, grads = self._jit_obj(params, sub)
+            b = self._batch(it)
+            loss, grads = self._jit_obj(params, sub, *b)
             self._score = float(loss)
             history.append(self._score)
             direction, state = self.direction(params, grads, state)
             if self.use_line_search:
                 ls = BackTrackLineSearch(
-                    lambda p, s=sub: self._jit_val(p, s))
+                    lambda p, s=sub, b=b: self._jit_val(p, s, *b))
                 # slope must be d(probed objective)·direction: include the L2
                 # term the probe value carries
                 probe_grads = grads
@@ -281,28 +299,30 @@ class StochasticHessianFree(BaseOptimizer):
         self._jit_cg = None
         self._jit_model = None
 
-    def _cvp(self, params, vec, key):
+    def _cvp(self, params, vec, key, b=()):
         """Curvature-vector product: Gauss-Newton J^T H_L J v when the
-        split is available, else full Hessian-vector product."""
+        split is available, else full Hessian-vector product.  ``b`` is the
+        current mini-batch (empty in whole-objective mode) — grad and
+        curvature share it within an iteration."""
         if self.gauss_newton is not None:
             predict, loss_out = self.gauss_newton
-            z, jv = jax.jvp(lambda p: predict(p, key), (params,), (vec,))
-            _, hjv = jax.jvp(jax.grad(loss_out), (z,), (jv,))
-            _, vjp_fn = jax.vjp(lambda p: predict(p, key), params)
+            z, jv = jax.jvp(lambda p: predict(p, key, *b), (params,), (vec,))
+            _, hjv = jax.jvp(jax.grad(lambda zz: loss_out(zz, *b)), (z,), (jv,))
+            _, vjp_fn = jax.vjp(lambda p: predict(p, key, *b), params)
             (gv,) = vjp_fn(hjv)
             return gv
-        grad_fn = lambda p: self.objective(p, key)[1]
+        grad_fn = lambda p: self.objective(p, key, *b)[1]
         _, hv = jax.jvp(grad_fn, (params,), (vec,))
         return hv
 
-    def _cg_solve(self, params, grads, key, damping):
+    def _cg_solve(self, params, grads, key, damping, batch=()):
         """Truncated CG on (G + λI) x = -g, compiled once: the whole loop is
         a ``lax.while_loop`` with a pytree carry, so the only host sync is
         the caller's use of the result."""
         if self._jit_cg is None:
             n_iters = self.cg_iterations
 
-            def cg(params, grads, key, lam):
+            def cg(params, grads, key, lam, *bt):
                 b = tm.neg(grads)
 
                 def cond(carry):
@@ -311,7 +331,7 @@ class StochasticHessianFree(BaseOptimizer):
 
                 def body(carry):
                     i, x, r, p, rs_old, live = carry
-                    hp = tm.axpy(lam, p, self._cvp(params, p, key))
+                    hp = tm.axpy(lam, p, self._cvp(params, p, key, bt))
                     denom = tm.dot(p, hp)
                     live = denom > 1e-20
                     alpha = jnp.where(live,
@@ -330,19 +350,20 @@ class StochasticHessianFree(BaseOptimizer):
                 return x
 
             self._jit_cg = jax.jit(cg)
-        return self._jit_cg(params, grads, key, jnp.asarray(damping, jnp.float32))
+        return self._jit_cg(params, grads, key,
+                            jnp.asarray(damping, jnp.float32), *batch)
 
-    def _model_quantities(self, params, d, grads, key, damping):
+    def _model_quantities(self, params, d, grads, key, damping, batch=()):
         """One jitted eval of (new_loss, damped quadratic-model reduction)."""
         if self._jit_model is None:
-            def model(params, d, grads, key, lam):
-                new_loss = self.objective(tm.add(params, d), key)[0]
+            def model(params, d, grads, key, lam, *bt):
+                new_loss = self.objective(tm.add(params, d), key, *bt)[0]
                 gd = tm.dot(grads, d)
-                dGd = tm.dot(d, tm.axpy(lam, d, self._cvp(params, d, key)))
+                dGd = tm.dot(d, tm.axpy(lam, d, self._cvp(params, d, key, bt)))
                 return new_loss, gd + 0.5 * dGd
             self._jit_model = jax.jit(model)
         return self._jit_model(params, d, grads, key,
-                               jnp.asarray(damping, jnp.float32))
+                               jnp.asarray(damping, jnp.float32), *batch)
 
     def optimize(self, params, key=None) -> OptimizeResult:
         key = key if key is not None else jax.random.key(self.conf.seed)
@@ -352,14 +373,15 @@ class StochasticHessianFree(BaseOptimizer):
         it = 0
         for it in range(self.conf.num_iterations):
             key, sub = jax.random.split(key)
-            loss, grads = self._jit_obj(params, sub)
+            b = self._batch(it)
+            loss, grads = self._jit_obj(params, sub, *b)
             self._score = float(loss)
             history.append(self._score)
-            d = self._cg_solve(params, grads, sub, self.damping)
+            d = self._cg_solve(params, grads, sub, self.damping, b)
             # quadratic-model reduction ratio → damping update (Martens §4.4;
             # reference dampingUpdate/reductionRatio)
             new_loss_dev, quad_dev = self._model_quantities(
-                params, d, grads, sub, self.damping)
+                params, d, grads, sub, self.damping, b)
             new_loss, quad = float(new_loss_dev), float(quad_dev)
             rho = (new_loss - self._score) / quad if quad != 0 else 0.0
             if rho > 0.75:

@@ -152,3 +152,29 @@ def test_score_decreases_during_training():
     s0 = net.score(ds)
     net.fit(ds)
     assert net.score(ds) < s0
+
+
+def test_hessian_free_finetune_minibatched_no_merged_array(monkeypatch):
+    """Second-order finetune at 10x the Iris corpus, fed as mini-batches:
+    the solver cycles one batch at a time (grad + CG curvature share the
+    iteration's batch, the stochastic-HF contract) and never materializes
+    the merged corpus — DataSet.merge is booby-trapped to prove it."""
+    base = iris_data()
+    reps = 10
+    feats = np.tile(np.asarray(base.features), (reps, 1))
+    labels = np.tile(np.asarray(base.labels), (reps, 1))
+    rng = np.random.default_rng(0)
+    feats = feats + rng.normal(0, 0.05, feats.shape).astype(np.float32)
+    big = DataSet(feats, labels).shuffle(seed=1)
+
+    monkeypatch.setattr(
+        DataSet, "merge",
+        staticmethod(lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("solver path must not merge batches"))))
+
+    net = MultiLayerNetwork(mlp_conf(
+        n_iter=60, algo=OptimizationAlgorithm.HESSIAN_FREE))
+    net.init(jax.random.key(0))
+    net.fit(big.batch_by(150))
+    ev = net.evaluate(base)
+    assert ev.f1() >= 0.9, ev.stats()
