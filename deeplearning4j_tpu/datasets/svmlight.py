@@ -97,18 +97,33 @@ def load_svmlight(path: str | Path, num_features: int, num_classes: int,
             if not line:     # a line STARTING before `end` is owned whole,
                 break        # even when it extends past the cut
             raw.append(line)
-    lines = [l for l in b"".join(raw).decode("utf-8").splitlines()
-             if l.split("#", 1)[0].strip()]
-    feats = np.zeros((len(lines), num_features), np.float32)
-    idx = np.zeros(len(lines), np.int64)
-    for i, line in enumerate(lines):
-        _, label = parse_svmlight_line(line, num_features, features_out=feats[i])
-        if label < 0 or label != int(label):
-            raise ValueError(
-                f"only non-negative integer class labels are supported "
-                f"(got {label!r}); see SVMLightDataFetcher.java:19-23")
-        idx[i] = int(label)
-    return feats, to_outcome_matrix(idx, num_classes)
+    data = b"".join(raw)
+
+    try:                     # native C fast path (host_runtime.cpp)
+        from ..native import runtime as native_rt
+        parsed = native_rt.parse_svmlight(data, num_features)
+    except ImportError:
+        parsed = None
+    if parsed is not None:
+        feats, labs, skipped = parsed
+        if skipped:
+            warnings.warn(f"{skipped} svmlight feature indices beyond "
+                          f"num_features={num_features}; skipped")
+    else:                    # Python parser: exact reference error semantics
+        lines = [l for l in data.decode("utf-8").splitlines()
+                 if l.split("#", 1)[0].strip()]
+        feats = np.zeros((len(lines), num_features), np.float32)
+        labs = np.zeros(len(lines), np.float32)
+        for i, line in enumerate(lines):
+            _, labs[i] = parse_svmlight_line(line, num_features,
+                                             features_out=feats[i])
+    invalid = ~np.isfinite(labs) | (labs < 0) | (labs != np.floor(labs))
+    if np.any(invalid):
+        bad = labs[invalid][0]
+        raise ValueError(
+            f"only non-negative integer class labels are supported "
+            f"(got {bad!r}); see SVMLightDataFetcher.java:19-23")
+    return feats, to_outcome_matrix(labs.astype(np.int64), num_classes)
 
 
 def save_svmlight(path: str | Path, features: np.ndarray,

@@ -52,6 +52,12 @@ def lib() -> ctypes.CDLL | None:
         l.drt_cooccurrence.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
+    if hasattr(l, "drt_parse_svmlight"):
+        l.drt_parse_svmlight.restype = ctypes.c_int64
+        l.drt_parse_svmlight.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
     _LIB = l
     return _LIB
 
@@ -159,3 +165,24 @@ def parse_csv_floats(text: str, n_cols: int) -> np.ndarray | None:
     if rows < 0:
         return None
     return out[:rows]
+
+
+def parse_svmlight(data: bytes, num_features: int):
+    """Native svmlight parse of a text buffer -> (dense features, float
+    labels, n_skipped_out_of_range); None -> use the Python parser (lib
+    missing, stale .so, or malformed input needing Python's exact errors)."""
+    l = lib()
+    if l is None or not hasattr(l, "drt_parse_svmlight"):
+        return None
+    max_rows = data.count(b"\n") + 2
+    feats = np.zeros((max_rows, num_features), np.float32)   # sparse rows
+    labels = np.empty(max_rows, np.float32)
+    skipped = ctypes.c_int64(0)
+    rows = l.drt_parse_svmlight(
+        data, len(data), num_features,
+        feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_rows, ctypes.byref(skipped))
+    if rows < 0:
+        return None
+    return feats[:rows], labels[:rows], int(skipped.value)

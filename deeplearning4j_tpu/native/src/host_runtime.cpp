@@ -144,6 +144,62 @@ char* drt_cooccurrence(const int32_t* tokens, const int64_t* offsets,
     return buf;
 }
 
+// ---------------------------------------------------------------- svmlight
+// Parse svmlight text ("<label> <idx>:<val> ... # comment", 1-based indices)
+// into dense row-major features + a label vector.  feats must be PRE-ZEROED
+// (rows are sparse); text must be NUL-terminated (ctypes c_char_p is).
+// Returns rows parsed; -1 on malformed input (caller falls back to the
+// Python parser for exact error semantics); -2 when max_rows is too small.
+// Indices beyond num_features are skipped and counted into *skipped (the
+// Python caller turns that into its out-of-range warning).
+int64_t drt_parse_svmlight(const char* text, int64_t len, int32_t nf,
+                           float* feats, float* labels, int64_t max_rows,
+                           int64_t* skipped) {
+    int64_t row = 0;
+    *skipped = 0;
+    const char* p = text;
+    const char* end = text + len;
+    while (p < end) {
+        const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
+        const char* le = nl ? nl : end;
+        const char* hash = static_cast<const char*>(std::memchr(p, '#', le - p));
+        const char* ce = hash ? hash : le;     // parse stops at the comment
+        const char* q = p;
+        while (q < ce && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+        if (q >= ce) { p = le + 1; continue; } // blank / comment-only line
+        if (row >= max_rows) return -2;
+        char* nxt = nullptr;
+        float lab = std::strtof(q, &nxt);      // stops at ' ', '#', '\n'
+        if (nxt == q || nxt > ce) return -1;   // no leading label
+        labels[row] = lab;
+        float* frow = feats + row * static_cast<int64_t>(nf);
+        q = nxt;
+        while (q < ce) {
+            while (q < ce && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+            if (q >= ce) break;
+            char* c1 = nullptr;
+            long idx = std::strtol(q, &c1, 10);
+            if (c1 == q || c1 >= ce || *c1 != ':') return -1;
+            // value must start right after ':' — strtof skips leading
+            // whitespace (incl. '\n'), which would silently consume a
+            // number from beyond the token/line; Python raises there
+            const char* vs = c1 + 1;
+            if (vs >= ce || *vs == ' ' || *vs == '\t' || *vs == '\r' ||
+                *vs == '\n') return -1;
+            char* c2 = nullptr;
+            float v = std::strtof(vs, &c2);
+            if (c2 == vs || c2 > ce) return -1;
+            if (idx <= 0) return -1;           // svmlight text is 1-based
+            if (idx <= nf) frow[idx - 1] = v;
+            else ++*skipped;
+            q = c2;
+        }
+        ++row;
+        p = le + 1;
+    }
+    return row;
+}
+
 // ---------------------------------------------------------------- csv
 // Parse a float CSV buffer into a dense row-major array. Returns rows
 // written, or -1 on ragged rows. out must hold max_rows*n_cols floats.

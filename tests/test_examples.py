@@ -49,3 +49,9 @@ def test_example_glove():
 def test_example_driver_checkpoint():
     out = _run("07_driver_checkpoint.py", timeout=420.0)
     assert "resumed" in out
+
+
+def test_example_svmlight_records():
+    out = _run("08_svmlight_records.py")
+    assert "accuracy = " in out
+    assert "(sum 400)" in out
