@@ -26,6 +26,11 @@ class ResNetConfig:
     width: int = 64
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    bn_fold: bool = False  # apply BN as a folded per-channel affine in the
+    #                        compute dtype (stats still f32): elementwise
+    #                        reads/writes drop to bf16 and the f32 cast fuses
+    #                        into the reductions — candidate for the r4
+    #                        ResNet MFU gap; default off until measured
     stem_space_to_depth: bool = True  # rewrite the 7x7/2 stem conv as an
     #                                   exactly-equivalent 4x4/1 conv on a
     #                                   2x2 space-to-depth input: C_in=3 is
@@ -127,14 +132,16 @@ def _conv(x, w, stride=1, dtype=jnp.bfloat16):
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
-def _bn(x, p, rs=None, train=True, momentum=0.9, eps=1e-5):
-    """BatchNorm in f32.  ``rs`` = running stats ``{"mean", "var"}``:
-    train mode normalizes with batch statistics (and, when ``rs`` is
-    given, returns EMA-updated running stats under stop_gradient); eval
-    mode normalizes with ``rs`` so inference is batch-independent.
-    Returns ``(y, new_rs)`` — ``new_rs`` is None when stats aren't
-    threaded (the bench-critical training path, byte-identical to the
-    stat-less r4 computation)."""
+def _bn(x, p, rs=None, train=True, momentum=0.9, eps=1e-5, fold=False):
+    """BatchNorm, statistics in f32.  ``rs`` = running stats
+    ``{"mean", "var"}``: train mode normalizes with batch statistics (and,
+    when ``rs`` is given, returns EMA-updated running stats under
+    stop_gradient); eval mode normalizes with ``rs`` so inference is
+    batch-independent.  ``fold=False`` does the elementwise normalize in
+    f32 (the r4 path, byte-identical); ``fold=True`` folds (mean, var,
+    scale, bias) into one per-channel affine applied in the input dtype —
+    same math, bf16 elementwise traffic.  Returns ``(y, new_rs)`` —
+    ``new_rs`` is None when stats aren't threaded."""
     x32 = x.astype(jnp.float32)
     if train:
         mean = x32.mean(axis=(0, 1, 2))
@@ -149,7 +156,12 @@ def _bn(x, p, rs=None, train=True, momentum=0.9, eps=1e-5):
             raise ValueError("eval-mode BN needs running stats "
                              "(init_batch_stats + a training pass)")
         mean, var, new_rs = rs["mean"], rs["var"], rs
-    y = (x32 - mean) * lax.rsqrt(var + eps)
+    inv = lax.rsqrt(var + eps)
+    if fold:
+        sc = (p["scale"] * inv).astype(x.dtype)
+        bi = (p["bias"] - p["scale"] * mean * inv).astype(x.dtype)
+        return x * sc + bi, new_rs
+    y = (x32 - mean) * inv
     return (y * p["scale"] + p["bias"]).astype(x.dtype), new_rs
 
 
@@ -178,12 +190,13 @@ def init_batch_stats(cfg: ResNetConfig) -> dict:
     return stats
 
 
-def _bottleneck(x, blk, stride, dtype, rs=None, train=True, momentum=0.9):
+def _bottleneck(x, blk, stride, dtype, rs=None, train=True, momentum=0.9,
+                fold=False):
     g = lambda name: None if rs is None else rs[name]
     new_rs = {} if rs is not None else None
 
     def bn(name, h):
-        y, n = _bn(h, blk[name], g(name), train, momentum)
+        y, n = _bn(h, blk[name], g(name), train, momentum, fold=fold)
         if new_rs is not None:
             new_rs[name] = n
         return y
@@ -239,7 +252,8 @@ def forward(params, images, cfg: ResNetConfig, batch_stats=None,
     rs = batch_stats
     new_stats = None if rs is None else {"stem": {}, "stages": []}
     x, n = _bn(x, params["stem"]["bn"],
-               None if rs is None else rs["stem"]["bn"], train, momentum)
+               None if rs is None else rs["stem"]["bn"], train, momentum,
+               fold=cfg.bn_fold)
     if rs is not None:
         new_stats["stem"]["bn"] = n
     x = jax.nn.relu(x)
@@ -251,7 +265,8 @@ def forward(params, images, cfg: ResNetConfig, batch_stats=None,
             stride = 2 if (s > 0 and b == 0) else 1
             x, n = _bottleneck(
                 x, blk, stride, dt,
-                None if rs is None else rs["stages"][s][b], train, momentum)
+                None if rs is None else rs["stages"][s][b], train, momentum,
+                fold=cfg.bn_fold)
             if rs is not None:
                 new_stats["stages"][s].append(n)
     x = x.mean(axis=(1, 2)).astype(jnp.float32)       # global average pool

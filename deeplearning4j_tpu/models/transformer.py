@@ -558,10 +558,23 @@ class TransformerLM:
         if specs is None:
             specs = (self.finetune_specs() if self._is_finetune_tree(params)
                      else self._specs())
-        tmpl, tspec = self._z1_template_and_specs(params, specs)
+        tmpl, _ = self._z1_template_and_specs(params, specs)
         state = (jnp.zeros((), jnp.int32), tx.init(tmpl))
+        return self.place(state, self.opt_specs_zero1(tx, specs))
+
+    def opt_specs_zero1(self, tx, params_specs=None, params=None):
+        """Placement specs for a ZeRO-1 ``(count, tx_state)`` tree — the
+        checkpoint-restore counterpart of ``init_opt_zero1`` (restore host
+        arrays, then ``place(opt, model.opt_specs_zero1(tx))``).  For a
+        finetune run pass the restored ``{"backbone", "head"}`` ``params``
+        (or explicit ``params_specs``) so the spec tree matches."""
+        if params_specs is None:
+            params_specs = (self.finetune_specs()
+                            if params is not None
+                            and self._is_finetune_tree(params)
+                            else self._specs())
         spec_fn = tx.state_spec or (lambda _: ())
-        return self.place(state, (P(), spec_fn(tspec)))
+        return (P(), spec_fn(self._z1_state_specs(params_specs)))
 
     def _z1_state_specs(self, specs):
         """ZeRO-1 state PartitionSpecs derivable from param specs alone

@@ -99,3 +99,49 @@ def test_eval_mode_is_batch_independent():
     batched_t = model.predict_logits(probe)[:1]
     assert not np.allclose(np.asarray(alone_t), np.asarray(batched_t),
                            rtol=1e-4, atol=1e-4)
+
+
+def test_bn_fold_matches_unfolded():
+    """bn_fold applies the identical normalization as the f32 path (folded
+    per-channel affine): exact at f32 compute, close at bf16; grads flow."""
+    import dataclasses
+
+    cfg = _cfg()                                  # f32 compute dtype
+    fcfg = dataclasses.replace(cfg, bn_fold=True)
+    params = init_params(jax.random.key(0), cfg)
+    x, y = _data()
+    l0 = forward(params, x, cfg)
+    l1 = forward(params, x, fcfg)
+    # folding reassociates the affine ((x-m)*inv*s+b vs x*(s*inv)+(b-m*inv*s));
+    # f32 rounding differences compound slightly across 18 layers
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                               rtol=1e-4, atol=1e-4)
+
+    # bf16: check at the single-BN level (end-to-end bf16-vs-bf16 diffs
+    # just measure compounded rounding, not the fold's correctness)
+    from deeplearning4j_tpu.models.resnet import _bn
+    h = jnp.asarray(np.random.default_rng(2)
+                    .standard_normal((8, 16, 16, 8)) * 3 + 1,
+                    jnp.bfloat16)
+    p = {"scale": jnp.asarray(np.random.default_rng(3).random(8) + 0.5,
+                              jnp.float32),
+         "bias": jnp.asarray(np.random.default_rng(4).random(8),
+                             jnp.float32)}
+    y0, _ = _bn(h, p, fold=False)
+    y1, _ = _bn(h, p, fold=True)
+    np.testing.assert_allclose(np.asarray(y0, np.float32),
+                               np.asarray(y1, np.float32),
+                               rtol=0.05, atol=0.05)
+
+    bfcfg = dataclasses.replace(cfg, dtype=jnp.bfloat16, bn_fold=True)
+    g = jax.grad(lambda pr: cross_entropy(pr, x, y, bfcfg))(params)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+    # running stats thread identically through the folded path
+    stats = init_batch_stats(cfg)
+    _, ns0 = forward(params, x, cfg, stats)
+    _, ns1 = forward(params, x, fcfg, stats)
+    # deeper-layer stats inherit the upstream reassociation rounding
+    for a, b in zip(jax.tree.leaves(ns0), jax.tree.leaves(ns1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

@@ -1,16 +1,22 @@
 #!/bin/bash
-# One-shot TPU measurement battery. Run when the relay is up (check:
+# One-shot TPU measurement battery (round 5). Run when the relay is up (check:
 # `python -c "import socket;s=socket.socket();print(s.connect_ex(('127.0.0.1',8080)))"`
-# prints 0). Writes TUNE_r04.jsonl + BENCH artifacts; serialize TPU access —
-# never run two TPU processes at once.
+# prints 0). Writes TUNE_r05.jsonl + trace/BENCH artifacts; serialize TPU
+# access — never run two TPU processes at once.
 set -u
 cd "$(dirname "$0")/.."
 
 echo "== flash validation + post-change sweep =="
-timeout 1500 python tools/tune_tpu.py post 2>/dev/null | tee TUNE_r04.jsonl
+timeout 1500 python tools/tune_tpu.py post 2>/dev/null | tee TUNE_r05.jsonl
 
-echo "== step-time ablation =="
-timeout 900 python tools/tune_tpu.py ablate 2>/dev/null | tee -a TUNE_r04.jsonl
+echo "== BERT step-time ablation =="
+timeout 900 python tools/tune_tpu.py ablate 2>/dev/null | tee -a TUNE_r05.jsonl
+
+echo "== ResNet step ablation (bn_fold variant) =="
+timeout 900 python tools/tune_tpu.py resnet_ablate 2>/dev/null | tee -a TUNE_r05.jsonl
+
+echo "== ResNet XPlane trace (top-op table) =="
+timeout 900 python tools/tune_tpu.py resnet_trace 2>/dev/null | tee -a TUNE_r05.jsonl
 
 echo "== full benchmark =="
 timeout 1800 python bench.py 2>bench_stderr.log
@@ -20,8 +26,8 @@ tail -3 bench_stderr.log
 rm -f bench_stderr.log
 
 echo
-echo "Next: if the flash rows in TUNE_r04.jsonl beat ring AND flash_check"
+echo "Next: if the flash rows in TUNE_r05.jsonl beat ring AND flash_check"
 echo "errors are < 0.05, set BENCH_ATTENTION=flash as the bench default"
 echo "(bench.py _bert_leg attention env default) and re-run bench.py; then"
-echo "commit TUNE_r04.jsonl + LAST_VALID_TPU_BENCH.json and update"
-echo "BASELINE.md's measured table."
+echo "commit TUNE_r05.jsonl + LAST_VALID_TPU_BENCH.json + the resnet trace"
+echo "summary and update BASELINE.md's measured table."

@@ -3,7 +3,8 @@ chip to pick bench.py's config (batch size, attention path).  Not part of
 the benchmark contract — bench.py remains the single source of truth; this
 script only informs which knobs bench.py should default to.
 
-Usage: python tools/tune_tpu.py bert|resnet|flash
+Usage: python tools/tune_tpu.py
+           post|ablate|resnet_ablate|resnet_trace|bert|resnet|flash
 """
 import json
 import os
@@ -194,28 +195,17 @@ def resnet_ablate(batch=256, iters=6):
     out["fwd_only_ms"] = time_fn(jax.jit(
         lambda p: R.cross_entropy(p, a, b, cfg)), params)
 
-    orig_bn = R._bn
-    from jax import lax
-
-    def bn_bf16(x, p, rs=None, train=True, momentum=0.9, eps=1e-5):
-        xb = x.astype(jnp.bfloat16)
-        mean = xb.mean(axis=(0, 1, 2))
-        var = ((xb - mean) ** 2).mean(axis=(0, 1, 2))
-        y = (xb - mean) * lax.rsqrt(var.astype(jnp.float32) + eps).astype(
-            jnp.bfloat16)
-        return ((y.astype(jnp.float32) * p["scale"] + p["bias"])
-                .astype(x.dtype), None)
-
+    # the shippable bf16-apply path: bn_fold=True (stats stay f32, the
+    # elementwise normalize becomes a folded per-channel bf16 affine)
+    import dataclasses
+    fcfg = dataclasses.replace(cfg, bn_fold=True)
     try:
-        R._bn = bn_bf16
-        out["fwd_bf16_bn_ms"] = time_fn(jax.jit(
-            lambda p: R.cross_entropy(p, a, b, cfg)), params)
-        out["grad_bf16_bn_ms"] = time_fn(jax.jit(
-            jax.grad(lambda p: R.cross_entropy(p, a, b, cfg))), params)
+        out["fwd_bnfold_ms"] = time_fn(jax.jit(
+            lambda p: R.cross_entropy(p, a, b, fcfg)), params)
+        out["grad_bnfold_ms"] = time_fn(jax.jit(
+            jax.grad(lambda p: R.cross_entropy(p, a, b, fcfg))), params)
     except Exception as e:
-        out["bf16_bn_error"] = repr(e)[:200]
-    finally:
-        R._bn = orig_bn
+        out["bnfold_error"] = repr(e)[:200]
     return out
 
 
@@ -224,17 +214,19 @@ def _xplane_top_ops(log_dir, n=12):
     the top-N table VERDICT item 2 asks to commit."""
     from pathlib import Path
 
-    from tensorflow.core.profiler.protobuf import xplane_pb2
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = sorted(Path(log_dir).rglob("*.xplane.pb"))
     if not paths:
         return {"error": f"no xplane.pb under {log_dir}"}
     xspace = xplane_pb2.XSpace()
     xspace.ParseFromString(paths[-1].read_bytes())
+    device = [pl for pl in xspace.planes
+              if "TPU" in pl.name or "/device" in pl.name.lower()]
+    if not device:                 # CPU run: fall back to the host plane
+        device = [pl for pl in xspace.planes if "/host:" in pl.name]
     totals = {}
-    for plane in xspace.planes:
-        if "TPU" not in plane.name and "/device" not in plane.name.lower():
-            continue
+    for plane in device:
         meta = {m_id: m.name for m_id, m in plane.event_metadata.items()}
         for line in plane.lines:
             for ev in line.events:
@@ -348,6 +340,18 @@ def main():
         return
     if which == "ablate":
         print(json.dumps(bert_ablate()), flush=True)
+        return
+    if which == "resnet_ablate":
+        try:
+            print(json.dumps({"resnet_ablate": resnet_ablate()}), flush=True)
+        except Exception as e:
+            print(json.dumps({"resnet_ablate_error": repr(e)[:300]}), flush=True)
+        return
+    if which == "resnet_trace":
+        try:
+            print(json.dumps({"resnet_trace": resnet_trace()}), flush=True)
+        except Exception as e:
+            print(json.dumps({"resnet_trace_error": repr(e)[:300]}), flush=True)
         return
     if which == "bert":
         for batch in (64, 128, 256):

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Poll the axon relay; when it opens, stabilize 60s, then run the TPU
+# battery ONCE and exit. Detach with:
+#   nohup bash tools/watch_relay.sh > watch_relay.log 2>&1 &
+# Guard: refuses to start the battery if another instance already did
+# (RELAY_BATTERY.lock) — TPU access must stay serialized.
+set -u
+cd "$(dirname "$0")/.."
+LOCK=RELAY_BATTERY.lock
+
+while true; do
+  if python3 -c '
+import socket, sys
+s = socket.socket(); s.settimeout(2)
+sys.exit(0 if s.connect_ex(("127.0.0.1", 8080)) == 0 else 1)'; then
+    if ! mkdir "$LOCK" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) relay open but lock held; exiting"
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) relay OPEN; stabilizing 60s"
+    sleep 60
+    bash tools/run_tpu_battery.sh 2>&1 | tee BATTERY_r05.log
+    echo "$(date -u +%FT%TZ) battery done"
+    exit 0
+  fi
+  sleep 60
+done
