@@ -210,6 +210,60 @@ def _validity_checks(name, iter_times, flops_per_iter, peak):
     return problems, mfu
 
 
+def _tune_rows(path="TUNE_r05.jsonl"):
+    """Rows from the on-chip tuning battery (tools/run_tpu_battery.sh), if
+    it has run; [] otherwise."""
+    rows = []
+    try:
+        full = os.path.join(os.path.dirname(os.path.abspath(__file__)), path)
+        with open(full) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def _pick_attention(rows):
+    """'flash' iff the battery proved the Pallas kernel correct on-chip
+    (flash_check errors < 0.05) AND faster than ring at the bench config —
+    evidence-based default so a battery run upgrades the headline without
+    a manual flip.  Returns (choice, reason)."""
+    checks = [r["flash_check"] for r in rows if isinstance(
+        r.get("flash_check"), dict)]
+    flash_ok = any(all(isinstance(v, (int, float)) and v < 0.05
+                       for v in c.values()) and c for c in checks)
+    def best(att):
+        ts = [r["tokens_per_sec"] for r in rows
+              if r.get("attention") == att and r.get("batch") == 64
+              and isinstance(r.get("tokens_per_sec"), (int, float))]
+        return max(ts) if ts else None
+    ring, flash = best("ring"), best("flash")
+    if flash_ok and ring and flash and flash > ring:
+        return "flash", (f"TUNE: flash {flash:.0f} > ring {ring:.0f} tok/s "
+                         "at batch 64, flash_check passed")
+    return "ring", "default (no on-chip evidence that flash wins)"
+
+
+def _pick_bn_fold(rows):
+    """True iff the battery showed the folded bf16 BN apply beating the f32
+    normalize at the bench batch.  Returns (choice, reason)."""
+    def best(fold):
+        ms = [r["mfu"] for r in rows
+              if r.get("bn_fold") is fold and r.get("batch") == 256
+              and isinstance(r.get("mfu"), (int, float))]
+        return max(ms) if ms else None
+    off, on = best(False), best(True)
+    if off and on and on > off:
+        return True, f"TUNE: bn_fold mfu {on:.3f} > {off:.3f} at batch 256"
+    return False, "default (no on-chip evidence that bn_fold wins)"
+
+
 def _bert_leg(dev, on_tpu, conserve_hbm=False):
     import jax
     import jax.numpy as jnp
@@ -217,10 +271,17 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         TransformerConfig, TransformerLM)
     from deeplearning4j_tpu.optimize import transforms as T
 
-    # BENCH_ATTENTION=flash opts the TPU legs into the Pallas flash kernel
-    # (ops/flash_attention.py); default stays the XLA ring/block path until
-    # a real-chip run validates the kernel end-to-end.
-    attention = os.environ.get("BENCH_ATTENTION", "ring")
+    # BENCH_ATTENTION=flash/ring overrides; otherwise the choice comes from
+    # on-chip tuning evidence (_pick_attention) and defaults to the XLA
+    # ring/block path when no battery has run.
+    attention = os.environ.get("BENCH_ATTENTION")
+    attention_reason = f"BENCH_ATTENTION={attention}" if attention else None
+    if attention is None:
+        attention, attention_reason = _pick_attention(_tune_rows())
+    if not on_tpu:
+        # the CPU smoke config always runs ring — say so rather than
+        # reporting a TUNE-based choice the leg did not use
+        attention, attention_reason = "ring", "cpu fallback (ring)"
     if on_tpu and conserve_hbm:
         # OOM retry path: remat + half batch (main() falls back here when
         # the full-size leg dies with RESOURCE_EXHAUSTED)
@@ -291,6 +352,7 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
     return {
         "name": "bert_base", "iters": iters, "batch": batch, "seq": seq,
         "attention": cfg.attention,
+        "attention_choice": attention_reason,
         "iter_times": iter_times, "stats": st,
         "e2e_stats": e2e, "prefetch_stats": pf,
         "tokens_per_sec": batch * seq / st["median_s"],
@@ -314,7 +376,15 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
     from deeplearning4j_tpu.optimize.transforms import apply_updates
 
     if on_tpu:
-        cfg = ResNetConfig.resnet50()
+        # BENCH_BN_FOLD=0/1 overrides; otherwise the folded bf16 BN apply
+        # (models/resnet.py bn_fold) turns on iff the on-chip tune battery
+        # showed it winning (_pick_bn_fold); default off.
+        env = os.environ.get("BENCH_BN_FOLD")
+        if env is not None:
+            bn_fold, fold_reason = env == "1", f"BENCH_BN_FOLD={env}"
+        else:
+            bn_fold, fold_reason = _pick_bn_fold(_tune_rows())
+        cfg = ResNetConfig.resnet50(bn_fold=bn_fold)
         # batch 256 ≈ 2x the MFU of batch 64 on v5e (tools/tune_tpu.py sweep:
         # 16.4% vs 8.3%) — small batches leave the MXU idle on the deep
         # low-resolution stages
@@ -322,6 +392,7 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
     else:
         cfg = ResNetConfig.resnet18(num_classes=10, dtype=jnp.float32)
         batch, size, iters = 4, 64, 3
+        fold_reason = "cpu fallback (bn_fold off)"
 
     tx = T.chain(T.momentum(0.9), T.sgd_lr(1e-2))
 
@@ -352,6 +423,7 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
     return {
         "name": "resnet", "iters": iters, "batch": batch, "image": size,
         "depth50": cfg.stage_sizes == (3, 4, 6, 3),
+        "bn_fold": cfg.bn_fold, "bn_fold_choice": fold_reason,
         "iter_times": iter_times, "stats": st,
         "images_per_sec": batch / st["median_s"],
         "flops_per_iter": cfg.flops_per_image(size) * batch,
@@ -709,6 +781,7 @@ def main():
            if "hbm_fallback" in bert else {}),
         "batch_seq": [bert["batch"], bert["seq"]],
         "attention": bert["attention"],
+        "attention_choice": bert.get("attention_choice"),
         "flops_per_token": round(bert["flops_per_token_analytic"]),
         **({"flops_analytic_over_xla": bert["flops_analytic_over_xla"]}
            if "flops_analytic_over_xla" in bert else {}),
@@ -717,6 +790,8 @@ def main():
                     "step_ms_median": round(resnet["stats"]["median_s"] * 1e3, 2),
                     "batch": resnet["batch"], "image": resnet["image"],
                     "resnet50": resnet["depth50"],
+                    "bn_fold": resnet["bn_fold"],
+                    "bn_fold_choice": resnet["bn_fold_choice"],
                     "loss": round(resnet["last_loss"], 4)}
                    if "error" not in resnet else resnet),
         "word2vec": w2v,

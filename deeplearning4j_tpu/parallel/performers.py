@@ -101,3 +101,40 @@ class SlowVectorDeltaPerformer(VectorDeltaPerformer):
     def perform(self, job: Job) -> None:
         time.sleep(0.25)
         super().perform(job)
+
+
+class SVMLightTrainPerformer:
+    """IterativeReduce worker over svmlight byte-range splits — the YARN
+    path's SVMLight worker (``hadoop-yarn/cdh4/.../IRUnitSVMLightWorkerTest``
+    pattern: each worker trains on its input split, the master averages).
+
+    ``job.work`` is ``"path::start::end::num_features::num_classes"``;
+    ``local_steps`` softmax-regression gradient steps over the split,
+    starting from the current averaged model (workers train locally, the
+    superstep averages — the IterativeReduce shape), emitting updated flat
+    params for the ``ArrayAggregator`` average."""
+
+    lr = 0.5
+    local_steps = 10
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def perform(self, job: Job) -> None:
+        from ..datasets.svmlight import load_svmlight
+        path, s, e, nf, nc = str(job.work).split("::")
+        s, e, nf, nc = int(s), int(e), int(nf), int(nc)
+        x, y = load_svmlight(path, nf, nc, start=s, end=e)
+        cur = self.tracker.get_current()
+        w = (np.zeros((nf, nc)) if cur is None
+             else np.asarray(cur).reshape(nf, nc))
+        for _ in range(self.local_steps):
+            logits = x @ w
+            logits -= logits.max(-1, keepdims=True)
+            p = np.exp(logits)
+            p /= p.sum(-1, keepdims=True)
+            w = w - self.lr * (x.T @ (p - y)) / max(len(x), 1)
+        job.result = w.reshape(-1)
+
+    def update(self, *args) -> None:
+        pass

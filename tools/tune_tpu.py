@@ -56,7 +56,7 @@ def bert_variant(batch, seq, attention, remat=False, iters=8):
             "mfu": round(flops / (med * 197e12), 4)}
 
 
-def resnet_variant(batch, iters=8):
+def resnet_variant(batch, iters=8, bn_fold=False):
     import jax
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.resnet import (ResNetConfig, cross_entropy,
@@ -64,7 +64,7 @@ def resnet_variant(batch, iters=8):
     from deeplearning4j_tpu.optimize import transforms as T
     from deeplearning4j_tpu.optimize.transforms import apply_updates
 
-    cfg = ResNetConfig.resnet50()
+    cfg = ResNetConfig.resnet50(bn_fold=bn_fold)
     tx = T.chain(T.momentum(0.9), T.sgd_lr(1e-2))
 
     def step(params, opt, images, labels):
@@ -91,7 +91,8 @@ def resnet_variant(batch, iters=8):
         times.append(time.perf_counter() - t0)
     med = _median(times)
     flops = cfg.flops_per_image(224) * batch
-    return {"batch": batch, "median_ms": round(med * 1e3, 2),
+    return {"batch": batch, "bn_fold": bn_fold,
+            "median_ms": round(med * 1e3, 2),
             "images_per_sec": round(batch / med, 1),
             "mfu": round(flops / (med * 197e12), 4)}
 
@@ -327,15 +328,18 @@ def main():
             print(json.dumps({"flash_check": flash_check()}), flush=True)
         except Exception as e:
             print(json.dumps({"flash_check_error": repr(e)[:300]}), flush=True)
-        for fn, args in ((bert_variant, (64, 512, "ring")),
-                         (bert_variant, (64, 512, "flash")),
-                         (bert_variant, (128, 512, "flash")),
-                         (resnet_variant, (256,)),
-                         (resnet_variant, (512,))):
+        for fn, args, kw in ((bert_variant, (64, 512, "ring"), {}),
+                             (bert_variant, (64, 512, "flash"), {}),
+                             (bert_variant, (128, 512, "ring"), {}),
+                             (bert_variant, (128, 512, "flash"), {}),
+                             (resnet_variant, (256,), {}),
+                             (resnet_variant, (256,), {"bn_fold": True}),
+                             (resnet_variant, (512,), {}),
+                             (resnet_variant, (512,), {"bn_fold": True})):
             try:
-                print(json.dumps(fn(*args)), flush=True)
+                print(json.dumps(fn(*args, **kw)), flush=True)
             except Exception as e:
-                print(json.dumps({"args": str(args),
+                print(json.dumps({"args": str(args) + str(kw),
                                   "error": repr(e)[:300]}), flush=True)
         return
     if which == "ablate":
