@@ -21,6 +21,7 @@ from .sentence import (
     LineSentenceIterator,
 )
 from .vocab import Huffman, VocabCache, VocabWord, build_vocab
+from .lm_dataset import LMCorpus, LMTokenBatchIterator
 from .word2vec import Word2Vec
 from .serializer import load_txt, save_txt, load_google_binary, save_google_binary
 from .glove import Glove
@@ -33,6 +34,7 @@ __all__ = [
     "CollectionSentenceIterator", "FileSentenceIterator",
     "LabelAwareListSentenceIterator", "LineSentenceIterator",
     "Huffman", "VocabCache", "VocabWord", "build_vocab",
+    "LMCorpus", "LMTokenBatchIterator",
     "Word2Vec", "Glove", "ParagraphVectors",
     "load_txt", "save_txt", "load_google_binary", "save_google_binary",
     "BagOfWordsVectorizer", "TfidfVectorizer",
