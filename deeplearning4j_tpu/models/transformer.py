@@ -427,6 +427,7 @@ class TransformerLM:
         self.mesh = mesh
         self._train_step = None
         self._fwd = None
+        self._sample_cache: dict = {}
 
     # -- single-device --------------------------------------------------
     def init(self, key=None) -> Params:
@@ -436,6 +437,56 @@ class TransformerLM:
         if self._fwd is None:
             self._fwd = jax.jit(partial(forward_local, cfg=self.cfg))
         return self._fwd(params, tokens)
+
+    def sample(self, params, prime, length: int, temperature: float = 1.0,
+               key=None) -> list:
+        """Temperature-sampled continuation of ``prime`` (greedy when
+        ``temperature <= 0``) — the transformer counterpart of
+        ``LSTMNet.sample`` (reference ``LSTM.java`` sampling seam).
+
+        TPU-idiomatic decode: the whole loop is ONE compiled
+        ``lax.fori_loop`` over a fixed ``(1, max_len)`` token buffer (no
+        per-token dispatch); causality makes the unwritten suffix inert.
+        Prime/generation lengths are traced int arguments, so every call
+        shares one executable per mode (greedy vs sampled).  Each step
+        recomputes the full forward — O(len·T) attention, fine for
+        max_len-scale generation; a KV-cache fast path is the next perf
+        rung if long-form decode becomes a workload.
+
+        ``key=None`` defaults to ``jax.random.key(0)`` — DETERMINISTIC,
+        like ``LSTMNet.sample``'s ``seed=0`` default; pass distinct keys
+        to collect diverse samples."""
+        cfg = self.cfg
+        assert cfg.causal, "sampling needs a causal LM (cfg.causal=True)"
+        P = len(prime)
+        assert 1 <= P and P + length <= cfg.max_len, (P, length, cfg.max_len)
+        if key is None:
+            key = jax.random.key(0)
+        greedy = temperature <= 0.0
+        fn = self._sample_cache.get(greedy)
+        if fn is None:
+            def run(params, toks, key, temp, p0, n):
+                def body(i, carry):
+                    toks, key = carry
+                    pos = p0 - 1 + i
+                    logits = forward_local(params, toks, cfg)[0, pos]
+                    key, sub = jax.random.split(key)
+                    if greedy:
+                        nxt = jnp.argmax(logits).astype(jnp.int32)
+                    else:
+                        nxt = jax.random.categorical(
+                            sub, logits / temp).astype(jnp.int32)
+                    return toks.at[0, pos + 1].set(nxt), key
+                toks, _ = lax.fori_loop(0, n, body, (toks, key))
+                return toks
+            fn = jax.jit(run)
+            self._sample_cache[greedy] = fn
+        toks0 = jnp.zeros((1, cfg.max_len), jnp.int32)
+        toks0 = toks0.at[0, :P].set(jnp.asarray(prime, jnp.int32))
+        toks = fn(params, toks0, key,
+                  jnp.float32(temperature if not greedy else 1.0),
+                  jnp.int32(P), jnp.int32(length))
+        return [int(t) for t in np.asarray(toks[0, :P + length])]
 
     # -- sharded train step --------------------------------------------
     def _axes(self):

@@ -55,3 +55,8 @@ def test_example_svmlight_records():
     out = _run("08_svmlight_records.py")
     assert "accuracy = " in out
     assert "(sum 400)" in out
+
+
+def test_example_lm_pretrain_generate():
+    out = _run("09_lm_pretrain_generate.py", timeout=420.0)
+    assert "greedy: the quick" in out and "loss:" in out
