@@ -352,6 +352,56 @@ def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
     return token_xent(h.reshape(n_tok, D), targets.reshape(n_tok)) / n_tok
 
 
+# --------------------------------------------------------------------------- KV-cached decode
+
+def init_decode_cache(cfg: TransformerConfig, batch: int = 1) -> list:
+    """Per-layer K/V buffers for incremental decoding: each layer caches
+    ``(B, max_len, H, Dh)`` keys and values; positions beyond the current
+    one stay zero and are masked out of the softmax."""
+    shape = (batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
+    """One incremental decode step: ``tokens`` (B,) are the ids at
+    position ``pos`` (traced int); returns ``(logits (B, V) f32,
+    new_cache)``.  O(T·D) per token — each layer attends the single new
+    query against its cached K/V instead of recomputing the full T×T
+    attention.  Single-device path (the tp/sp sharded model trains; decode
+    serves), numerics mirror ``_block``: bf16 matmuls, f32 softmax/LN."""
+    dt = cfg.dtype
+    x = (jnp.take(params["tok_embed"], tokens, axis=0)
+         + params["pos_embed"][pos]).astype(dt)                 # (B, D)
+    scale = cfg.head_dim ** -0.5
+    valid = jnp.arange(cfg.max_len) <= pos                       # (T,)
+    new_cache = []
+    for lp, c in zip(params["layers"], cache):
+        h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = jnp.einsum("bd,dshe->bshe", h.astype(dt), lp["wqkv"].astype(dt))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]               # (B, H, Dh)
+        ck = lax.dynamic_update_slice_in_dim(c["k"], k[:, None], pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(c["v"], v[:, None], pos, axis=1)
+        new_cache.append({"k": ck, "v": cv})
+        s = jnp.einsum("bhd,bthd->bht", q, ck,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(valid[None, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("bht,bthd->bhd", p.astype(dt), cv,
+                         preferred_element_type=jnp.float32).astype(dt)
+        proj = jnp.einsum("bhe,hed->bd", att, lp["wo"].astype(dt))
+        x = x + proj.astype(x.dtype)
+        h2 = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
+        u = jnp.einsum("bd,df->bf", h2.astype(dt), lp["w1"].astype(dt))
+        u = jax.nn.gelu(u + lp["b1"].astype(dt))
+        down = jnp.einsum("bf,fd->bd", u, lp["w2"].astype(dt))
+        down = down + lp["b2"].astype(dt)
+        x = x + down.astype(x.dtype)
+    h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return (h.astype(dt) @ head.astype(dt)).astype(jnp.float32), new_cache
+
+
 def encode_local(params, tokens, cfg: TransformerConfig, *,
                  n_sp: int = 1, sp_axis: str | None = None,
                  tp_axis: str | None = None) -> jnp.ndarray:
@@ -439,7 +489,7 @@ class TransformerLM:
         return self._fwd(params, tokens)
 
     def sample(self, params, prime, length: int, temperature: float = 1.0,
-               key=None) -> list:
+               key=None, kv_cache: bool = False) -> list:
         """Temperature-sampled continuation of ``prime`` (greedy when
         ``temperature <= 0``) — the transformer counterpart of
         ``LSTMNet.sample`` (reference ``LSTM.java`` sampling seam).
@@ -448,10 +498,15 @@ class TransformerLM:
         ``lax.fori_loop`` over a fixed ``(1, max_len)`` token buffer (no
         per-token dispatch); causality makes the unwritten suffix inert.
         Prime/generation lengths are traced int arguments, so every call
-        shares one executable per mode (greedy vs sampled).  Each step
-        recomputes the full forward — O(len·T) attention, fine for
-        max_len-scale generation; a KV-cache fast path is the next perf
-        rung if long-form decode becomes a workload.
+        shares one executable per (mode, kv_cache) pair.
+
+        Two decode paths: the default recomputes the full forward per
+        token (O(T²) attention — simplest, exercises the training
+        graph); ``kv_cache=True`` decodes incrementally through
+        :func:`decode_step` — O(T·D) per token, same numerics class (bf16
+        matmuls, f32 softmax), parity-tested against the full path, and
+        drawing the SAME RNG stream (the key advances only on generation
+        steps, so a given key yields the same continuation either way).
 
         ``key=None`` defaults to ``jax.random.key(0)`` — DETERMINISTIC,
         like ``LSTMNet.sample``'s ``seed=0`` default; pass distinct keys
@@ -463,24 +518,52 @@ class TransformerLM:
         if key is None:
             key = jax.random.key(0)
         greedy = temperature <= 0.0
-        fn = self._sample_cache.get(greedy)
+        fn = self._sample_cache.get((greedy, kv_cache))
         if fn is None:
-            def run(params, toks, key, temp, p0, n):
-                def body(i, carry):
-                    toks, key = carry
-                    pos = p0 - 1 + i
-                    logits = forward_local(params, toks, cfg)[0, pos]
-                    key, sub = jax.random.split(key)
-                    if greedy:
-                        nxt = jnp.argmax(logits).astype(jnp.int32)
-                    else:
-                        nxt = jax.random.categorical(
-                            sub, logits / temp).astype(jnp.int32)
-                    return toks.at[0, pos + 1].set(nxt), key
-                toks, _ = lax.fori_loop(0, n, body, (toks, key))
-                return toks
+            def pick(logits, sub, temp):
+                if greedy:
+                    return jnp.argmax(logits).astype(jnp.int32)
+                return jax.random.categorical(sub, logits / temp).astype(
+                    jnp.int32)
+
+            if kv_cache:
+                def run(params, toks, key, temp, p0, n):
+                    cache = init_decode_cache(cfg, 1)
+
+                    def body(i, carry):
+                        toks, cache, key = carry
+                        logits, cache = decode_step(
+                            params, cache, toks[:, i], i, cfg)
+                        new_key, sub = jax.random.split(key)
+                        # advance the RNG only on GENERATION steps, so the
+                        # draw sequence matches the non-cached path (which
+                        # never splits during prime prefill)
+                        gen = i + 1 >= p0
+                        key = jax.random.wrap_key_data(jnp.where(
+                            gen, jax.random.key_data(new_key),
+                            jax.random.key_data(key)))
+                        nxt = pick(logits[0], sub, temp)
+                        cur = toks[0, i + 1]
+                        toks = toks.at[0, i + 1].set(
+                            jnp.where(gen, nxt, cur))
+                        return toks, cache, key
+
+                    toks, _, _ = lax.fori_loop(0, p0 + n - 1, body,
+                                               (toks, cache, key))
+                    return toks
+            else:
+                def run(params, toks, key, temp, p0, n):
+                    def body(i, carry):
+                        toks, key = carry
+                        pos = p0 - 1 + i
+                        logits = forward_local(params, toks, cfg)[0, pos]
+                        key, sub = jax.random.split(key)
+                        nxt = pick(logits, sub, temp)
+                        return toks.at[0, pos + 1].set(nxt), key
+                    toks, _ = lax.fori_loop(0, n, body, (toks, key))
+                    return toks
             fn = jax.jit(run)
-            self._sample_cache[greedy] = fn
+            self._sample_cache[(greedy, kv_cache)] = fn
         toks0 = jnp.zeros((1, cfg.max_len), jnp.int32)
         toks0 = toks0.at[0, :P].set(jnp.asarray(prime, jnp.int32))
         toks = fn(params, toks0, key,

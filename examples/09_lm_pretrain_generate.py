@@ -59,8 +59,8 @@ def main():
     out = model.sample(params, prime, length=6, temperature=0.0)
     print("greedy:", " ".join(corpus.decode(out)))
     out = model.sample(params, prime, length=6, temperature=0.8,
-                       key=jax.random.key(7))
-    print("sampled:", " ".join(corpus.decode(out)))
+                       key=jax.random.key(7), kv_cache=True)
+    print("sampled (kv-cached):", " ".join(corpus.decode(out)))
 
 
 if __name__ == "__main__":

@@ -60,3 +60,4 @@ def test_example_svmlight_records():
 def test_example_lm_pretrain_generate():
     out = _run("09_lm_pretrain_generate.py", timeout=420.0)
     assert "greedy: the quick" in out and "loss:" in out
+    assert "kv-cached" in out
