@@ -260,6 +260,15 @@ def ring_attention(q, k, v, *, n_sp: int, sp_axis: str | None, causal: bool,
     return out.astype(q.dtype)
 
 
+def _ffn(lp, h, dt):
+    """The FFN sublayer body on (..., D) activations — shared verbatim by
+    the training ``_block`` and the incremental ``decode_step`` so the two
+    paths cannot silently diverge (tp boundaries stay with the caller)."""
+    u = jnp.einsum("...d,df->...f", h.astype(dt), lp["w1"].astype(dt))
+    u = jax.nn.gelu(u + lp["b1"].astype(dt))
+    return jnp.einsum("...f,fd->...d", u, lp["w2"].astype(dt))
+
+
 def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
     """One transformer block, tp/sp-aware (runs inside shard_map)."""
     dt = cfg.dtype
@@ -282,9 +291,7 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
     h2 = _layernorm(x, params["ln2_scale"], params["ln2_bias"])
     if tp_axis:
         h2 = copy_to_tp(h2, tp_axis)
-    u = jnp.einsum("btd,df->btf", h2.astype(dt), params["w1"].astype(dt))
-    u = jax.nn.gelu(u + params["b1"].astype(dt))
-    down = jnp.einsum("btf,fd->btd", u, params["w2"].astype(dt))
+    down = _ffn(params, h2, dt)
     if tp_axis:
         down = reduce_from_tp(down, tp_axis)
     down = down + params["b2"].astype(dt)
@@ -392,10 +399,7 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         proj = jnp.einsum("bhe,hed->bd", att, lp["wo"].astype(dt))
         x = x + proj.astype(x.dtype)
         h2 = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
-        u = jnp.einsum("bd,df->bf", h2.astype(dt), lp["w1"].astype(dt))
-        u = jax.nn.gelu(u + lp["b1"].astype(dt))
-        down = jnp.einsum("bf,fd->bd", u, lp["w2"].astype(dt))
-        down = down + lp["b2"].astype(dt)
+        down = _ffn(lp, h2, dt) + lp["b2"].astype(dt)
         x = x + down.astype(x.dtype)
     h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
@@ -570,6 +574,57 @@ class TransformerLM:
                   jnp.float32(temperature if not greedy else 1.0),
                   jnp.int32(P), jnp.int32(length))
         return [int(t) for t in np.asarray(toks[0, :P + length])]
+
+    def beam_search(self, params, prime, length: int, beam_width: int = 5
+                    ) -> tuple[list, float]:
+        """Highest-log-likelihood continuation of ``prime`` — the
+        ``LSTM.java`` BeamSearch seam on the flagship.  Returns
+        ``(token sequence, total log prob)``.
+
+        The device does the O(W·T·D) work through the KV-cached
+        :func:`decode_step` with the beam as the batch axis; the tiny
+        top-k bookkeeping (sort W·V scores, reorder W cache rows) runs on
+        host per step — beam decode is a quality tool, not a throughput
+        path."""
+        cfg = self.cfg
+        assert cfg.causal, "beam search needs a causal LM (cfg.causal=True)"
+        # more beams than vocabulary entries cannot all be distinct
+        P, W = len(prime), min(beam_width, cfg.vocab_size)
+        assert 1 <= P and P + length <= cfg.max_len, (P, length, cfg.max_len)
+        fn = self._sample_cache.get(("beam_step", W))
+        if fn is None:
+            fn = jax.jit(lambda p, c, t, i: decode_step(p, c, t, i, cfg))
+            self._sample_cache[("beam_step", W)] = fn
+
+        toks = jnp.zeros((W, cfg.max_len), jnp.int32)
+        toks = toks.at[:, :P].set(jnp.asarray(prime, jnp.int32)[None])
+        cache = init_decode_cache(cfg, W)
+        for i in range(P - 1):                       # prefill
+            _, cache = fn(params, cache, toks[:, i], jnp.int32(i))
+
+        scores = np.zeros(W)
+        for i in range(P - 1, P - 1 + length):
+            logits, cache = fn(params, cache, toks[:, i], jnp.int32(i))
+            logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))  # (W, V)
+            if i == P - 1:
+                # all beams are identical clones of the prime: branch the
+                # top-W tokens from ONE row (else W duplicate beams)
+                top = np.argsort(-logp[0])[:W]
+                beam_idx, next_toks, scores = np.zeros(W, int), top, logp[0][top]
+            else:
+                flat = (scores[:, None] + logp).reshape(-1)
+                top = np.argsort(-flat)[:W]
+                beam_idx, next_toks = np.divmod(top, logp.shape[1])
+                scores = flat[top]
+            sel = jnp.asarray(beam_idx)
+            toks = jnp.take(toks, sel, axis=0).at[:, i + 1].set(
+                jnp.asarray(next_toks, jnp.int32))
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, sel, axis=0), cache)
+
+        best = int(np.argmax(scores))
+        return ([int(t) for t in np.asarray(toks[best, :P + length])],
+                float(scores[best]))
 
     # -- sharded train step --------------------------------------------
     def _axes(self):

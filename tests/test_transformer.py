@@ -476,3 +476,35 @@ def test_kv_cached_decode_matches_full_forward():
     c1 = model.sample(params, prime, 9, temperature=0.8,
                       key=jax.random.key(4), kv_cache=True)
     assert c0 == c1, (c0, c1)
+
+
+def test_beam_search_on_flagship():
+    """Beam search (LSTM.java BeamSearch seam on the flagship): width-1
+    equals greedy decode; wider beams never score worse; the trained cycle
+    is recovered with a finite log prob."""
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    period = [3, 1, 4, 1, 5, 9, 2, 6]
+    cfg = tiny_cfg(vocab_size=16, causal=True)
+    stream = np.array(period * 32, np.int32)
+    span = cfg.max_len + 1
+    n = len(stream) // span
+    blocks = stream[:n * span].reshape(n, span)
+    model = TransformerLM(cfg)
+    tx = T.adamw(0.01)
+    params = model.init(jax.random.key(0))
+    opt = model.init_opt(params, tx)
+    step = model.build_train_step(tx)
+    tr_t, tr_y = jnp.asarray(blocks[:, :-1]), jnp.asarray(blocks[:, 1:])
+    for _ in range(50):
+        params, opt, _ = step(params, opt, tr_t, tr_y)
+
+    prime = period[:3]
+    greedy = model.sample(params, prime, 9, temperature=0.0)
+    b1, s1 = model.beam_search(params, prime, 9, beam_width=1)
+    assert b1 == greedy, (b1, greedy)
+
+    b5, s5 = model.beam_search(params, prime, 9, beam_width=5)
+    assert np.isfinite(s5) and s5 <= 0.0
+    assert s5 >= s1 - 1e-5          # wider beam can't score worse
+    assert b5 == (period * 3)[:len(b5)], b5
