@@ -432,6 +432,49 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
     }
 
 
+def _decode_leg(dev, on_tpu):
+    """Inference decode throughput: KV-cached greedy generation on a
+    GPT-base-class causal model (the flagship's serving path; the
+    reference has no generation story to compare against — this is a
+    beats-reference metric).  Host sync is inherent: sample() returns the
+    realized token list."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, TransformerLM)
+
+    if on_tpu:
+        cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
+                                n_layers=12, d_ff=3072, max_len=512,
+                                causal=True, dtype=jnp.bfloat16, remat=False)
+        prime_len, gen = 32, 480
+    else:
+        cfg = TransformerConfig(vocab_size=1024, d_model=128, n_heads=4,
+                                n_layers=2, d_ff=256, max_len=128,
+                                causal=True, dtype=jnp.float32, remat=False)
+        prime_len, gen = 8, 56
+    model = TransformerLM(cfg)
+    with jax.default_device(dev):
+        params = model.init(jax.random.key(0))
+        prime = list(range(1, prime_len + 1))
+        model.sample(params, prime, gen, temperature=0.0,
+                     kv_cache=True)                     # compile + warmup
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = model.sample(params, prime, gen, temperature=0.0,
+                               kv_cache=True)
+            runs.append(time.perf_counter() - t0)
+        assert len(out) == prime_len + gen
+    med = statistics.median(runs)
+    steps = prime_len + gen - 1       # prefill steps run in the same loop
+    return {"mode": "kv_cached_greedy", "prime": prime_len,
+            "generated": gen, "decode_steps": steps,
+            "runs_s": [round(t, 3) for t in runs],
+            "ms_per_step": round(med / steps * 1e3, 3),
+            "generated_tokens_per_sec_incl_prefill": round(gen / med, 1)}
+
+
 def _word2vec_leg(dev, on_tpu):
     """Embeddings-path throughput: the batched HS and NS skip-gram device
     kernels (text/word2vec.py — the hot loops the reference hand-optimized
@@ -740,6 +783,11 @@ def main():
     except Exception as e:                      # embeddings leg must not kill bench
         w2v = {"error": repr(e)[:300]}
 
+    try:
+        decode = _decode_leg(dev, on_tpu)
+    except Exception as e:                      # decode leg must not kill bench
+        decode = {"error": repr(e)[:300]}
+
     scaling = _scaling_leg()
     # when we could not reach the chip, at least prove the REAL configs
     # compile and record XLA's FLOPs for them (no timing claim)
@@ -795,6 +843,7 @@ def main():
                     "loss": round(resnet["last_loss"], 4)}
                    if "error" not in resnet else resnet),
         "word2vec": w2v,
+        "decode": decode,
         "dp_machinery_check": scaling,
         **({"real_config_compile_check": real_compile} if real_compile else {}),
         "wall_s": round(time.time() - t_start, 1),

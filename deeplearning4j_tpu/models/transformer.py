@@ -481,6 +481,7 @@ class TransformerLM:
         self.mesh = mesh
         self._train_step = None
         self._fwd = None
+        self._score_fn = None
         self._sample_cache: dict = {}
 
     # -- single-device --------------------------------------------------
@@ -574,6 +575,16 @@ class TransformerLM:
                   jnp.float32(temperature if not greedy else 1.0),
                   jnp.int32(P), jnp.int32(length))
         return [int(t) for t in np.asarray(toks[0, :P + length])]
+
+    def score(self, params, tokens, targets) -> float:
+        """Mean token cross entropy (model ``score`` seam, reference
+        ``MultiLayerNetwork.score``); ``exp(score)`` is perplexity."""
+        if self._score_fn is None:
+            cfg = self.cfg
+            self._score_fn = jax.jit(
+                lambda p, t, y: lm_loss_local(p, t, y, cfg))
+        return float(self._score_fn(params, jnp.asarray(tokens),
+                                    jnp.asarray(targets)))
 
     def beam_search(self, params, prime, length: int, beam_width: int = 5
                     ) -> tuple[list, float]:

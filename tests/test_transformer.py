@@ -508,3 +508,19 @@ def test_beam_search_on_flagship():
     assert np.isfinite(s5) and s5 <= 0.0
     assert s5 >= s1 - 1e-5          # wider beam can't score worse
     assert b5 == (period * 3)[:len(b5)], b5
+
+
+def test_score_matches_train_step_loss():
+    """score() (the reference Model.score seam) reports the same mean token
+    cross entropy the train step computes at the current params."""
+    cfg = tiny_cfg(causal=True)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(0))
+    tokens, _ = data(cfg)
+    targets = jnp.roll(tokens, -1, axis=1)
+    s = model.score(params, tokens, targets)
+    copy = jax.tree_util.tree_map(jnp.array, params)
+    opt = model.init_opt(copy, lr=0.0)
+    _, _, loss = model.build_train_step(lr=0.0)(copy, opt, tokens, targets)
+    np.testing.assert_allclose(s, float(loss), rtol=1e-6)
+    assert abs(s - np.log(cfg.vocab_size)) < 0.5     # untrained ~ uniform
