@@ -197,6 +197,20 @@ class PipelinedTransformerLM(TransformerLM):
     def init(self, key=None) -> dict:
         return stack_layers(super().init(key))
 
+    def _unstacked_only(name):
+        def guard(self, *a, **kw):
+            raise NotImplementedError(
+                f"{name} runs on the unstacked single-device layout: "
+                f"TransformerLM(cfg).{name}(unstack_layers("
+                "jax.device_get(params), cfg.n_layers), ...)")
+        guard.__name__ = name
+        return guard
+
+    sample = _unstacked_only("sample")
+    beam_search = _unstacked_only("beam_search")
+    score = _unstacked_only("score")
+    del _unstacked_only
+
     def _specs(self):
         return pipeline_param_specs(self.cfg)
 

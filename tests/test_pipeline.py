@@ -289,3 +289,21 @@ def test_pp_zero1_checkpoint_resume_parity(tmp_path):
 
     for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(ref_params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pipelined_decode_guards_point_to_unstack():
+    """sample/beam_search/score on the stacked layout fail with a clear
+    pointer to the unstack interchange instead of a shape error deep in
+    forward_local — and the suggested path actually works."""
+    cfg = _cfg(n_layers=2)
+    mesh = make_mesh(MeshSpec(dp=1, pp=2, sp=1, tp=1), devices=jax.devices()[:2])
+    model = PipelinedTransformerLM(cfg, mesh, n_micro=2)
+    params = model.place(model.init(jax.random.key(0)))
+    for fn in (model.sample, model.beam_search, model.score):
+        with pytest.raises(NotImplementedError, match="unstack"):
+            fn(params, [1, 2], 4)
+
+    solo = TransformerLM(cfg)
+    flat = unstack_layers(jax.device_get(params), cfg.n_layers)
+    out = solo.sample(flat, [1, 2], 4, temperature=0.0)
+    assert len(out) == 6
