@@ -5,6 +5,7 @@
 # access — never run two TPU processes at once.
 set -u
 cd "$(dirname "$0")/.."
+exec > >(tee BATTERY_r05.log) 2>&1     # the battery writes its own log
 
 echo "== flash validation + post-change sweep =="
 timeout 1500 python tools/tune_tpu.py post 2>/dev/null | tee TUNE_r05.jsonl
@@ -26,8 +27,8 @@ tail -3 bench_stderr.log
 rm -f bench_stderr.log
 
 echo
-echo "Next: if the flash rows in TUNE_r05.jsonl beat ring AND flash_check"
-echo "errors are < 0.05, set BENCH_ATTENTION=flash as the bench default"
-echo "(bench.py _bert_leg attention env default) and re-run bench.py; then"
-echo "commit TUNE_r05.jsonl + LAST_VALID_TPU_BENCH.json + the resnet trace"
-echo "summary and update BASELINE.md's measured table."
+echo "Next: python tools/summarize_tune.py  (markdown table + the flash/"
+echo "bn_fold verdicts). bench.py ADOPTS winners from TUNE_r05.jsonl"
+echo "automatically (_pick_attention/_pick_bn_fold) — no manual flip needed;"
+echo "commit TUNE_r05.jsonl + BATTERY_r05.log + LAST_VALID_TPU_BENCH.json"
+echo "and paste the summary into BASELINE.md's measured table."

@@ -1,0 +1,69 @@
+"""Render TUNE_r05.jsonl (the on-chip battery's output) as a markdown
+table ready for BASELINE.md's measured section, plus the flash/bn_fold
+adoption verdicts bench.py would derive from it.
+
+Usage: python tools/summarize_tune.py [path-to-jsonl]
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "TUNE_r05.jsonl"
+    # absolute: bench._tune_rows resolves relative paths against the REPO
+    # root, not the caller's cwd
+    path = os.path.abspath(path)
+    import bench
+    rows = bench._tune_rows(path)
+    if not rows:
+        print(f"no rows in {path} (battery not run yet?)")
+        return 1
+
+    errors = [r for r in rows
+              if any("error" in k for k in r if isinstance(k, str))]
+    if errors:
+        print(f"!! {len(errors)} battery leg(s) ERRORED — the tables below "
+              "cover only the legs that ran:")
+        for r in errors:
+            print("   ", json.dumps(r)[:200])
+        print()
+
+    print("## BERT variants\n")
+    print("| batch | seq | attention | remat | median ms | tokens/s | MFU |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        if "tokens_per_sec" in r and "attention" in r:
+            print(f"| {r['batch']} | {r['seq']} | {r['attention']} | "
+                  f"{r.get('remat', False)} | {r['median_ms']} | "
+                  f"{r['tokens_per_sec']:,.0f} | {r['mfu']:.1%} |")
+
+    print("\n## ResNet-50 variants\n")
+    print("| batch | bn_fold | median ms | img/s | MFU |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        if "images_per_sec" in r:
+            print(f"| {r['batch']} | {r.get('bn_fold', False)} | "
+                  f"{r['median_ms']} | {r['images_per_sec']:,.0f} | "
+                  f"{r['mfu']:.1%} |")
+
+    for r in rows:
+        if isinstance(r.get("flash_check"), dict):
+            print("\nflash_check:", json.dumps(r["flash_check"]))
+        for k in ("resnet_trace", "resnet_ablate"):
+            if k in r:
+                print(f"\n{k}:", json.dumps(r[k])[:600])
+        if "full_step_ms" in r:
+            print("\nbert ablation:", json.dumps(r))
+
+    att, att_why = bench._pick_attention(rows)
+    fold, fold_why = bench._pick_bn_fold(rows)
+    print(f"\nbench would adopt: attention={att} ({att_why}); "
+          f"bn_fold={fold} ({fold_why})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

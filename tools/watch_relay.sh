@@ -19,7 +19,7 @@ sys.exit(0 if s.connect_ex(("127.0.0.1", 8080)) == 0 else 1)'; then
     fi
     echo "$(date -u +%FT%TZ) relay OPEN; stabilizing 60s"
     sleep 60
-    bash tools/run_tpu_battery.sh 2>&1 | tee BATTERY_r05.log
+    bash tools/run_tpu_battery.sh      # writes BATTERY_r05.log itself
     echo "$(date -u +%FT%TZ) battery done"
     exit 0
   fi
