@@ -134,10 +134,15 @@ def _discover_devices(attempts: int = None, timeout_s: float = None,
 
 
 def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
-                prefetch=False):
+                prefetch=False, metric=None):
     """Run ``iters`` steps rotating batches, syncing to host EVERY
     iteration.  Returns (iter_times, last_loss, params, opt) — params/opt
     are threaded back out because train steps donate their input buffers.
+
+    ``metric``: also record every step time into the observability
+    registry's ``metric`` histogram (``observe_time``), so the BENCH_*
+    numbers and a scrape of ``/metrics`` during the run agree on the same
+    raw observations.
 
     ``float(np.asarray(loss))`` inside the loop is the synchronization an
     async/misbehaving platform cannot fake: the scalar cannot arrive on
@@ -153,6 +158,13 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
     """
     import jax
 
+    from deeplearning4j_tpu.observability import METRICS
+
+    def record(dt):
+        iter_times.append(dt)
+        if metric is not None:
+            METRICS.observe_time(metric, dt)
+
     if stage_on_device:
         batches = [tuple(map(jax.device_put, b)) for b in batches]
     iter_times, loss = [], None
@@ -164,7 +176,7 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
             t0 = time.perf_counter()
             params, opt, loss = step(params, opt, a, b)
             loss = float(np.asarray(loss))       # forced host sync
-            iter_times.append(time.perf_counter() - t0)
+            record(time.perf_counter() - t0)
         return iter_times, loss, params, opt
     for k in range(iters):
         a, b = batches[k % len(batches)]
@@ -173,7 +185,7 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
             a, b = jax.device_put(a), jax.device_put(b)
         params, opt, loss = step(params, opt, a, b)
         loss = float(np.asarray(loss))           # forced host sync
-        iter_times.append(time.perf_counter() - t0)
+        record(time.perf_counter() - t0)
     return iter_times, loss, params, opt
 
 
@@ -335,16 +347,20 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         # end-to-end first (device_put serialized into each step), then the
         # double-buffered production pipeline, then the device-staged run
         # the headline is computed from (see module doc #5)
-        e2e_times, _, params, opt = _timed_loop(step, params, opt, batches, iters)
+        e2e_times, _, params, opt = _timed_loop(
+            step, params, opt, batches, iters,
+            metric="bench.bert_base.step_e2e")
         # the prefetched leg's per-step timer starts AFTER the generator
         # pull, so device_put issuance hides outside it — also record the
         # whole-loop wall clock (includes every pull) alongside
         pf_wall0 = time.perf_counter()
         pf_times, _, params, opt = _timed_loop(
-            step, params, opt, batches, iters, prefetch=True)
+            step, params, opt, batches, iters, prefetch=True,
+            metric="bench.bert_base.step_prefetch")
         pf_wall_s = time.perf_counter() - pf_wall0
         iter_times, last_loss, params, opt = _timed_loop(
-            step, params, opt, batches, iters, stage_on_device=True)
+            step, params, opt, batches, iters, stage_on_device=True,
+            metric="bench.bert_base.step")
 
     st = _stats(iter_times)
     e2e = _stats(e2e_times)
@@ -417,7 +433,8 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
         params, opt, loss = jstep(params, opt, a, b)
         float(np.asarray(loss))
         iter_times, last_loss, params, opt = _timed_loop(
-            jstep, params, opt, batches, iters, stage_on_device=True)
+            jstep, params, opt, batches, iters, stage_on_device=True,
+            metric="bench.resnet.step")
 
     st = _stats(iter_times)
     return {
@@ -704,6 +721,16 @@ def _real_config_compile_check(timeout_s: float = 540.0):
         return {"error": str(e)[:300]}
 
 
+def _registry_timers():
+    """Timer summaries from the observability registry, rounded for the
+    artifact (BENCH_* and /metrics agree because both read these)."""
+    from deeplearning4j_tpu.observability import METRICS
+
+    return {name: {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in summary.items()}
+            for name, summary in METRICS.snapshot()["timers"].items()}
+
+
 def main():
     t_start = time.time()
     devices, fallback_reason, probe_failures = _discover_devices()
@@ -847,6 +874,9 @@ def main():
         "dp_machinery_check": scaling,
         **({"real_config_compile_check": real_compile} if real_compile else {}),
         "wall_s": round(time.time() - t_start, 1),
+        # same raw observations the /metrics endpoint would serve during
+        # the run (bench._timed_loop records through the registry)
+        "observability_timers": _registry_timers(),
         **({"timing_warnings": "; ".join(timing_warnings)}
            if timing_warnings else {}),
         **({"fallback": fallback_reason} if fallback_reason else {}),

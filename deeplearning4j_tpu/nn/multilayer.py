@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import time
 from pathlib import Path
 from typing import Any, Callable, Sequence
 
@@ -36,6 +37,7 @@ import numpy as np
 
 from ..datasets.dataset import DataSet, to_outcome_matrix
 from ..evaluation import Evaluation
+from ..observability import METRICS, enabled as _obs_enabled, trace
 from ..optimize import transforms as tfm
 from ..utils import tree_math as tm
 from .conf import LayerKind, MultiLayerConfiguration, OptimizationAlgorithm
@@ -167,22 +169,25 @@ class MultiLayerNetwork:
             if not isinstance(layer, BasePretrainLayer):
                 continue
             conf = layer.conf
-            transform = tfm.from_conf(conf)
-            step = self._pretrain_step(i, layer, transform)
-            lparams = self.params[i]
-            tstate = transform.init(lparams)
-            for b, batch in enumerate(batches):
-                x = jnp.asarray(batch.features)
-                # inputs to layer i are fixed while layer i trains
-                inp = self._forward_to(i, x)
-                for it in range(max(1, conf.num_iterations)):
-                    key, sub = jax.random.split(key)
-                    lparams, tstate, loss = step(lparams, tstate, inp, sub,
-                                                 jnp.asarray(it))
-                self._score = float(loss)
-            new_params = list(self.params)
-            new_params[i] = lparams
-            self.params = tuple(new_params)
+            with trace.span("multilayer.pretrain_layer", layer=i,
+                            kind=conf.kind.value):
+                transform = tfm.from_conf(conf)
+                step = self._pretrain_step(i, layer, transform)
+                lparams = self.params[i]
+                tstate = transform.init(lparams)
+                for b, batch in enumerate(batches):
+                    x = jnp.asarray(batch.features)
+                    # inputs to layer i are fixed while layer i trains
+                    inp = self._forward_to(i, x)
+                    for it in range(max(1, conf.num_iterations)):
+                        key, sub = jax.random.split(key)
+                        lparams, tstate, loss = step(lparams, tstate, inp, sub,
+                                                     jnp.asarray(it))
+                    self._score = float(loss)
+                new_params = list(self.params)
+                new_params[i] = lparams
+                self.params = tuple(new_params)
+            METRICS.increment("multilayer.pretrain_layers")
             log.info("pretrained layer %d (%s) score %.5f", i, conf.kind.value, self._score)
 
     def _forward_to(self, i: int, x):
@@ -239,6 +244,8 @@ class MultiLayerNetwork:
         for batch in batches:
             x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
             for _ in range(max(1, out_conf.num_iterations)):
+                obs = _obs_enabled()
+                t0 = time.perf_counter() if obs else 0.0
                 key, sub = jax.random.split(key)
                 # Rebind self.params/self._tstates IMMEDIATELY: the step
                 # donates its inputs, so the previous buffers are dead the
@@ -249,6 +256,11 @@ class MultiLayerNetwork:
                 self._tstates = tstate
                 it += 1
                 self._score = float(loss)
+                if obs:
+                    METRICS.observe_time("multilayer.fit_iteration",
+                                         time.perf_counter() - t0)
+                    METRICS.increment("multilayer.iterations")
+                    METRICS.gauge("multilayer.loss", self._score)
                 for l in self.listeners:
                     l.iteration_done(self, it)
 
@@ -313,10 +325,13 @@ class MultiLayerNetwork:
         k_pre = k_fine = None
         if key is not None:
             k_pre, k_fine = jax.random.split(key)
-        if self.conf.pretrain:
-            self.pretrain(data_or_iter, k_pre)
-        if self.conf.backprop:
-            self.finetune(data_or_iter, k_fine)
+        with trace.span("multilayer.fit", n_layers=len(self.layers)):
+            if self.conf.pretrain:
+                with trace.span("multilayer.pretrain"):
+                    self.pretrain(data_or_iter, k_pre)
+            if self.conf.backprop:
+                with trace.span("multilayer.finetune"):
+                    self.finetune(data_or_iter, k_fine)
         return self
 
     def fit_arrays(self, features, labels_or_idx, key=None) -> "MultiLayerNetwork":

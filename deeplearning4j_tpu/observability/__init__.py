@@ -1,0 +1,34 @@
+"""Observability: structured tracing + metrics + status/metrics HTTP.
+
+The production observability layer (grown from the seed
+``parallel/observe.py``; that module remains as a compat shim):
+
+- ``trace`` (module alias) / ``span`` — nestable spans with contextvar
+  propagation, Chrome-trace (Perfetto) + JSONL export (``tracing``)
+- ``METRICS`` / ``MetricsRegistry`` — counters, gauges, timing histograms
+  with p50/p95/p99, Prometheus text exposition (``metrics``)
+- ``StatusServer`` — ``/healthz`` ``/metrics`` ``/metrics.prom`` ``/status``
+- ``sample_device_memory`` — per-device HBM gauges
+- ``enabled``/``enable``/``disable`` — process-global flag;
+  zero-per-step-allocation when off (see ``core``)
+"""
+
+from . import tracing as trace
+from .core import NOOP_SPAN, disable, enable, enabled
+from .device import sample_device_memory
+from .metrics import (
+    DEFAULT_TIME_BUCKETS,
+    METRICS,
+    Histogram,
+    MetricsRegistry,
+    StepTimer,
+)
+from .server import StatusServer
+from .tracing import TRACER, Tracer, profiler_trace, span
+
+__all__ = [
+    "DEFAULT_TIME_BUCKETS", "Histogram", "METRICS", "MetricsRegistry",
+    "NOOP_SPAN", "StatusServer", "StepTimer", "TRACER", "Tracer",
+    "disable", "enable", "enabled", "profiler_trace",
+    "sample_device_memory", "span", "trace",
+]

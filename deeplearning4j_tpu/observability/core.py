@@ -1,0 +1,54 @@
+"""Shared observability state: the global enable flag and its contract.
+
+The contract (DESIGN.md §9): when observability is disabled, instrumented
+hot paths must do no per-step Python allocation — ``span()`` hands back one
+shared no-op context manager, registry mutators return before touching the
+lock, and call sites gate their ``time.perf_counter()`` reads on
+``enabled()``.  The flag is process-global and module-level so the check is
+one attribute load + truth test.
+
+Default is ON (observability is cheap relative to a jitted step dispatch);
+benchmarks that want the bare loop set ``DL4J_TPU_OBS=0`` or call
+``disable()``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ENABLED: bool = os.environ.get("DL4J_TPU_OBS", "1") not in ("0", "false", "off")
+
+
+def enabled() -> bool:
+    """Is observability collection on?  Safe to call per-step."""
+    return _ENABLED
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager returned by every disabled-path
+    ``span()``/``time()`` call — one instance for the whole process, so the
+    disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:  # matches Span.set
+        pass
+
+
+NOOP_SPAN = _NoopSpan()

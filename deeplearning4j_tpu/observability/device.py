@@ -1,0 +1,39 @@
+"""Device-memory gauges.
+
+Samples ``jax.local_devices()[*].memory_stats()`` into the metrics
+registry.  TPU/GPU backends report ``bytes_in_use`` / ``peak_bytes_in_use``
+/ ``bytes_limit``; the CPU backend returns ``None`` — sampling is then a
+no-op, so instrumented paths can call this unconditionally.
+"""
+
+from __future__ import annotations
+
+from . import core
+from .metrics import METRICS, MetricsRegistry
+
+_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def sample_device_memory(registry: MetricsRegistry = METRICS) -> int:
+    """Gauge per-device memory stats; returns how many devices reported."""
+    if not core.enabled():
+        return 0
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:
+        return 0
+    reported = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        reported += 1
+        prefix = f"device.{d.id}."
+        for k in _KEYS:
+            if k in stats:
+                registry.gauge(prefix + k, float(stats[k]))
+    return reported

@@ -1,0 +1,237 @@
+"""Metrics: counters, gauges, and bucketed timing histograms.
+
+Grown from the seed ``parallel/observe.py`` registry (counters + flat timer
+lists) into the production surface: every timer is a ``Histogram`` with
+Prometheus-style cumulative buckets plus a bounded window of raw values for
+percentile snapshots (p50/p95/p99), and the whole registry renders to
+Prometheus text exposition format (``to_prometheus``) alongside the JSON
+``snapshot``.
+
+All mutation goes through the registry lock; the seed's
+``StepTimer.iteration_done`` wrote ``registry.timers[name].append(...)``
+directly, bypassing it — that path is now the locked ``observe_time``.
+When observability is disabled (``core.disable()``) every mutator returns
+before taking the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Iterable
+
+from . import core
+
+# Default buckets for timings in seconds: 0.5ms .. 60s, roughly 2.5x steps —
+# wide enough for a CPU-test microstep and a pod-slice BERT step alike.
+DEFAULT_TIME_BUCKETS: tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Raw-value window per histogram for percentile estimation.  Percentiles are
+# over the most recent WINDOW observations (a ring buffer), which is what a
+# step-time dashboard wants anyway; bucket counts/sum/count remain exact
+# over the full lifetime.
+WINDOW = 4096
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class Histogram:
+    """Cumulative-bucket histogram + bounded raw-value window.
+
+    Not internally locked: the owning registry serializes access.
+    """
+
+    __slots__ = ("buckets", "bucket_counts", "count", "total", "values")
+
+    def __init__(self, buckets: Iterable[float] = DEFAULT_TIME_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        self.bucket_counts = [0] * len(self.buckets)  # cumulative on render
+        self.count = 0
+        self.total = 0.0
+        self.values: deque[float] = deque(maxlen=WINDOW)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.total += value
+        self.values.append(value)
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                self.bucket_counts[i] += 1
+                break
+
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] — +Inf row is implicit
+        (``count``)."""
+        out, acc = [], 0
+        for ub, c in zip(self.buckets, self.bucket_counts):
+            acc += c
+            out.append((ub, acc))
+        return out
+
+    def summary(self) -> dict[str, float]:
+        vals = sorted(self.values)
+        return {
+            "count": self.count,
+            "total_s": self.total,
+            "mean_s": self.total / self.count if self.count else 0.0,
+            "p50_s": _percentile(vals, 0.50),
+            "p95_s": _percentile(vals, 0.95),
+            "p99_s": _percentile(vals, 0.99),
+            "max_s": vals[-1] if vals else float("nan"),
+        }
+
+
+class _Timer:
+    """``with registry.time(name):`` — observes elapsed seconds on exit."""
+
+    __slots__ = ("registry", "name", "t0")
+
+    def __init__(self, registry: "MetricsRegistry", name: str):
+        self.registry = registry
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.registry.observe_time(self.name, time.perf_counter() - self.t0)
+        return False
+
+
+def _prom_name(name: str) -> str:
+    """Dotted registry names -> Prometheus metric names."""
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _prom_float(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v))
+
+
+class MetricsRegistry:
+    """Process-wide named counters/gauges/timing-histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = defaultdict(float)
+        self.gauges: dict[str, float] = {}
+        self.timers: dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------- mutation
+    def increment(self, name: str, by: float = 1.0) -> None:
+        if not core.enabled():
+            return
+        with self._lock:
+            self.counters[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        if not core.enabled():
+            return
+        with self._lock:
+            self.gauges[name] = value
+
+    def observe_time(self, name: str, seconds: float,
+                     buckets: Iterable[float] | None = None) -> None:
+        """Record one timing observation under the registry lock (the only
+        sanctioned way in — no caller touches ``timers[...]`` directly)."""
+        if not core.enabled():
+            return
+        with self._lock:
+            h = self.timers.get(name)
+            if h is None:
+                h = self.timers[name] = Histogram(buckets or DEFAULT_TIME_BUCKETS)
+            h.observe(seconds)
+
+    def time(self, name: str):
+        """Context manager timing its body into the ``name`` histogram."""
+        if not core.enabled():
+            return core.NOOP_SPAN
+        return _Timer(self, name)
+
+    def reset(self) -> None:
+        """Drop all recorded state (test isolation for the global
+        ``METRICS`` singleton)."""
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.timers.clear()
+
+    # ------------------------------------------------------------- export
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {k: h.summary() for k, h in self.timers.items()
+                           if h.count},
+            }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (0.0.4).
+
+        Counters get a ``_total`` suffix (convention), timers render as
+        native histograms in seconds (``_seconds_bucket/_sum/_count``).
+        """
+        lines: list[str] = []
+        with self._lock:
+            for name in sorted(self.counters):
+                pn = _prom_name(name)
+                if not pn.endswith("_total"):
+                    pn += "_total"
+                lines.append(f"# TYPE {pn} counter")
+                lines.append(f"{pn} {_prom_float(self.counters[name])}")
+            for name in sorted(self.gauges):
+                pn = _prom_name(name)
+                lines.append(f"# TYPE {pn} gauge")
+                lines.append(f"{pn} {_prom_float(self.gauges[name])}")
+            for name in sorted(self.timers):
+                h = self.timers[name]
+                pn = _prom_name(name)
+                if not pn.endswith("_seconds"):
+                    pn += "_seconds"
+                lines.append(f"# TYPE {pn} histogram")
+                for ub, acc in h.cumulative_buckets():
+                    lines.append(f'{pn}_bucket{{le="{_prom_float(ub)}"}} {acc}')
+                lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
+                lines.append(f"{pn}_sum {_prom_float(h.total)}")
+                lines.append(f"{pn}_count {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+METRICS = MetricsRegistry()
+
+
+class StepTimer:
+    """IterationListener recording per-iteration wall time and score into
+    the registry — via the locked ``observe_time`` path (the seed version
+    appended to ``registry.timers[...]`` directly, racing ``snapshot``)."""
+
+    def __init__(self, registry: MetricsRegistry = METRICS, name: str = "train_step"):
+        self.registry = registry
+        self.name = name
+        self._last = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self.registry.observe_time(self.name, now - self._last)
+        self._last = now
+        self.registry.increment(f"{self.name}.iterations")
+        if hasattr(model, "score"):
+            try:
+                self.registry.gauge(f"{self.name}.score", float(model.score()))
+            except Exception:
+                pass

@@ -1,0 +1,177 @@
+"""Span-based structured tracing.
+
+``with trace.span("train_step", step=i):`` opens a nestable span; nesting
+propagates through a ``contextvars.ContextVar`` so spans opened on worker
+threads / asyncio tasks attribute to the right parent.  Completed spans
+land in a bounded in-memory buffer and (optionally) stream to a JSONL
+event log.  The buffer exports as Chrome trace-event JSON — complete
+("ph":"X") events with microsecond ``ts``/``dur``, ``pid`` = JAX process
+index (host index on a pod slice), ``tid`` = OS thread id — loadable in
+Perfetto / chrome://tracing.
+
+Zero-overhead contract: when observability is disabled, ``span()`` returns
+the shared no-op context manager (no allocation); see ``core``.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+from . import core
+
+_EPOCH = time.perf_counter()
+_MAX_EVENTS = 65536
+
+# Innermost-open-span chain, per context (thread / task).
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "dl4j_tpu_current_span", default=None)
+
+_process_index: int | None = None
+
+
+def _pid() -> int:
+    """JAX process index (host index), lazily resolved; 0 without jax."""
+    global _process_index
+    if _process_index is None:
+        try:
+            import jax
+            _process_index = int(jax.process_index())
+        except Exception:
+            _process_index = 0
+    return _process_index
+
+
+class Span:
+    """One nestable timed region.  Use via ``tracer.span(...)``."""
+
+    __slots__ = ("tracer", "name", "attrs", "parent", "depth",
+                 "t0_us", "tid", "_token")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.parent: Span | None = None
+        self.depth = 0
+
+    def set(self, **attrs) -> None:
+        """Attach/override attributes while the span is open."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self.parent = _current.get()
+        self.depth = self.parent.depth + 1 if self.parent is not None else 0
+        self._token = _current.set(self)
+        self.tid = threading.get_ident()
+        self.t0_us = (time.perf_counter() - _EPOCH) * 1e6
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur_us = (time.perf_counter() - _EPOCH) * 1e6 - self.t0_us
+        _current.reset(self._token)
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self.tracer._record(self, dur_us)
+        return False
+
+
+class Tracer:
+    """Collects completed spans; exports Chrome trace JSON and JSONL."""
+
+    def __init__(self, max_events: int = _MAX_EVENTS):
+        self._lock = threading.Lock()
+        self.events: deque[dict[str, Any]] = deque(maxlen=max_events)
+        self._jsonl: Any = None  # open file handle when streaming
+
+    # ------------------------------------------------------------- record
+    def span(self, name: str, **attrs):
+        """Open a span context manager (no-op singleton when disabled)."""
+        if not core.enabled():
+            return core.NOOP_SPAN
+        return Span(self, name, attrs)
+
+    def _record(self, span: Span, dur_us: float) -> None:
+        ev = {
+            "name": span.name,
+            "ph": "X",
+            "ts": span.t0_us,
+            "dur": dur_us,
+            "pid": _pid(),
+            "tid": span.tid,
+            "args": dict(span.attrs,
+                         parent=span.parent.name if span.parent else None,
+                         depth=span.depth),
+        }
+        with self._lock:
+            self.events.append(ev)
+            if self._jsonl is not None:
+                self._jsonl.write(json.dumps(ev) + "\n")
+                self._jsonl.flush()
+
+    # ------------------------------------------------------------- export
+    def to_chrome_trace(self) -> dict[str, Any]:
+        """Perfetto/chrome://tracing-loadable trace object."""
+        with self._lock:
+            return {"traceEvents": list(self.events), "displayTimeUnit": "ms"}
+
+    def save_chrome_trace(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.write_text(json.dumps(self.to_chrome_trace()))
+        return path
+
+    def export_jsonl(self, path: str | Path) -> Path:
+        """Dump the buffered events as one JSON object per line."""
+        path = Path(path)
+        with self._lock:
+            with open(path, "w") as f:
+                for ev in self.events:
+                    f.write(json.dumps(ev) + "\n")
+        return path
+
+    def stream_jsonl(self, path: str | Path) -> None:
+        """Append each completed span to ``path`` as it closes (crash-safe
+        event log; survives a process that never reaches export)."""
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+            self._jsonl = open(path, "a")
+
+    def stop_stream(self) -> None:
+        with self._lock:
+            if self._jsonl is not None:
+                self._jsonl.close()
+                self._jsonl = None
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+
+TRACER = Tracer()
+
+
+def span(name: str, **attrs):
+    """Module-level convenience: ``with trace.span("fit", epochs=2):``."""
+    return TRACER.span(name, **attrs)
+
+
+def profiler_trace(log_dir: str):
+    """Context manager: JAX profiler trace (XPlane) to ``log_dir`` — the
+    XLA-level companion to the host-side spans above."""
+    import jax
+
+    class _Trace:
+        def __enter__(self):
+            jax.profiler.start_trace(log_dir)
+            return self
+
+        def __exit__(self, *exc):
+            jax.profiler.stop_trace()
+
+    return _Trace()

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import METRICS, trace
+
 
 def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
@@ -56,6 +58,14 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
     def save(self, step: int, params, tstate=None, key=None,
              data_cursor: int = 0, extra: dict | None = None) -> Path:
+        with trace.span("checkpoint.save", step=step), \
+                METRICS.time("checkpoint.save"):
+            path = self._save(step, params, tstate, key, data_cursor, extra)
+        METRICS.increment("checkpoint.saves")
+        return path
+
+    def _save(self, step: int, params, tstate=None, key=None,
+              data_cursor: int = 0, extra: dict | None = None) -> Path:
         ckpt_dir = self.directory / f"ckpt_{step:010d}"
         tmp = Path(tempfile.mkdtemp(dir=self.directory))
         try:
@@ -103,6 +113,14 @@ class CheckpointManager:
     def restore(self, params_template, tstate_template=None,
                 step: int | None = None) -> dict:
         """Returns dict(step, params, tstate, key, data_cursor, extra)."""
+        with trace.span("checkpoint.restore"), \
+                METRICS.time("checkpoint.restore"):
+            out = self._restore(params_template, tstate_template, step)
+        METRICS.increment("checkpoint.restores")
+        return out
+
+    def _restore(self, params_template, tstate_template=None,
+                 step: int | None = None) -> dict:
         step = step if step is not None else self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")

@@ -25,9 +25,9 @@ from typing import Iterable
 
 import jax
 
+from ..observability import METRICS, StatusServer, sample_device_memory, trace
 from .checkpoint import CheckpointManager
 from .mesh import MeshSpec, initialize_multihost, make_mesh
-from .observe import METRICS, StatusServer
 from .trainer import DataParallelTrainer, TrainState
 
 
@@ -67,14 +67,16 @@ class Driver:
             resume: bool = True, key=None) -> tuple[TrainState, list[float]]:
         """Fit to completion (with auto-resume when a checkpoint manager is
         configured); returns the final state and per-step losses."""
-        state = self.trainer.init_state(params, key=key)
-        state, losses = self.trainer.fit(
-            state, list(batches), epochs=epochs,
-            checkpoint_manager=self.checkpoint_manager,
-            checkpoint_every=self.checkpoint_every, resume=resume)
+        with trace.span("driver.run", epochs=epochs):
+            state = self.trainer.init_state(params, key=key)
+            state, losses = self.trainer.fit(
+                state, list(batches), epochs=epochs,
+                checkpoint_manager=self.checkpoint_manager,
+                checkpoint_every=self.checkpoint_every, resume=resume)
         METRICS.increment("driver.steps", len(losses))
         if losses:
             METRICS.gauge("driver.loss", losses[-1])
+        sample_device_memory()
         return state, losses
 
     def final_params(self, state: TrainState):

@@ -1,164 +1,22 @@
-"""Observability: metrics registry + REST status endpoint.
+"""Compat shim — the observability layer moved to
+``deeplearning4j_tpu.observability``.
 
-Capability match of the reference's observability surface (SURVEY.md §5.5):
-SLF4J logging ≡ stdlib logging; distributed counters ≡ ``StateTracker``
-counters; the dropwizard REST resource exposing tracker state
-(``StateTracker.startRestApi``, ``StateTrackerDropWizardResource.java:28``)
-≡ a stdlib ThreadingHTTPServer serving JSON; plus a step-timer/profiler
-hook (``jax.profiler`` trace toggles) the reference lacks.
+The seed's 164-line counter registry + JSON status server grew into a
+subpackage (span tracer with Chrome-trace/JSONL export, histogram metrics
+with Prometheus exposition, device-memory gauges).  Import from
+``deeplearning4j_tpu.observability``; this module re-exports the old names
+so existing callers keep working.
 """
 
 from __future__ import annotations
 
-import json
-import threading
-import time
-from collections import defaultdict
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from ..observability import (  # noqa: F401
+    METRICS,
+    MetricsRegistry,
+    StatusServer,
+    StepTimer,
+    profiler_trace,
+)
 
-
-class MetricsRegistry:
-    """Process-wide named counters/gauges/timers."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.counters: dict[str, float] = defaultdict(float)
-        self.gauges: dict[str, float] = {}
-        self.timers: dict[str, list[float]] = defaultdict(list)
-
-    def increment(self, name: str, by: float = 1.0) -> None:
-        with self._lock:
-            self.counters[name] += by
-
-    def gauge(self, name: str, value: float) -> None:
-        with self._lock:
-            self.gauges[name] = value
-
-    def time(self, name: str):
-        registry = self
-
-        class _Timer:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-                return self
-
-            def __exit__(self, *exc):
-                with registry._lock:
-                    registry.timers[name].append(time.perf_counter() - self.t0)
-
-        return _Timer()
-
-    def snapshot(self) -> dict[str, Any]:
-        with self._lock:
-            return {
-                "counters": dict(self.counters),
-                "gauges": dict(self.gauges),
-                "timers": {k: {"count": len(v), "mean_s": sum(v) / len(v),
-                               "total_s": sum(v)}
-                           for k, v in self.timers.items() if v},
-            }
-
-
-METRICS = MetricsRegistry()
-
-
-class StepTimer:
-    """IterationListener that records per-iteration wall time and score into
-    the metrics registry (profiler hook, SURVEY.md §5.1 obligation)."""
-
-    def __init__(self, registry: MetricsRegistry = METRICS, name: str = "train_step"):
-        self.registry = registry
-        self.name = name
-        self._last = None
-
-    def iteration_done(self, model, iteration: int) -> None:
-        now = time.perf_counter()
-        if self._last is not None:
-            self.registry.timers[self.name].append(now - self._last)
-        self._last = now
-        self.registry.increment(f"{self.name}.iterations")
-        if hasattr(model, "score"):
-            try:
-                self.registry.gauge(f"{self.name}.score", float(model.score()))
-            except Exception:
-                pass
-
-
-def profiler_trace(log_dir: str):
-    """Context manager: JAX profiler trace (XPlane) to ``log_dir``."""
-    import jax
-
-    class _Trace:
-        def __enter__(self):
-            jax.profiler.start_trace(log_dir)
-            return self
-
-        def __exit__(self, *exc):
-            jax.profiler.stop_trace()
-
-    return _Trace()
-
-
-class StatusServer:
-    """REST endpoint: /status (tracker state), /metrics (registry),
-    /healthz.  Read-only, JSON; replaces the dropwizard resource."""
-
-    def __init__(self, tracker=None, registry: MetricsRegistry = METRICS,
-                 host: str = "127.0.0.1", port: int = 0):
-        self.tracker = tracker
-        self.registry = registry
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def do_GET(self):
-                if self.path == "/healthz":
-                    payload = {"ok": True}
-                elif self.path == "/metrics":
-                    payload = outer.registry.snapshot()
-                elif self.path == "/status":
-                    payload = outer._tracker_state()
-                else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = json.dumps(payload).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread: threading.Thread | None = None
-
-    def _tracker_state(self) -> dict:
-        t = self.tracker
-        if t is None:
-            return {}
-        return {
-            "workers": t.workers(),
-            "enabled": {w: t.is_enabled(w) for w in t.workers()},
-            "heartbeats_age_s": {w: round(time.time() - t.last_heartbeat(w), 3)
-                                 for w in t.workers()},
-            "current_jobs": len(t.current_jobs()),
-            "pending_updates": sorted(t.updates().keys()),
-            # in-memory tracker exposes its counter dict; the file-backed
-            # tracker has no cheap enumerate — omit rather than scan disk
-            "counters": dict(getattr(t, "_counters", {})),
-            "done": t.is_done(),
-        }
-
-    def start(self) -> "StatusServer":
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+__all__ = ["METRICS", "MetricsRegistry", "StatusServer", "StepTimer",
+           "profiler_trace"]

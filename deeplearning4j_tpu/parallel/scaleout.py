@@ -36,6 +36,8 @@ from typing import Any, Callable, Iterator, Protocol, Sequence
 
 import numpy as np
 
+from ..observability import METRICS, trace
+
 
 # --------------------------------------------------------------------------- jobs
 
@@ -240,6 +242,7 @@ class StateTracker:
         with self._lock:
             self._updates[worker_id] = update
             listeners = list(self.update_listeners)
+        METRICS.increment("scaleout.updates")
         for l in listeners:
             l(update)
 
@@ -417,10 +420,12 @@ class DistributedRunner:
             if job is None:
                 time.sleep(self.poll_s)
                 continue
-            performer.perform(job)
+            with METRICS.time("scaleout.job"):
+                performer.perform(job)
             if job.result is not None:
                 self.tracker.add_update(worker_id, job.result)
             self.tracker.clear_job(worker_id)
+            METRICS.increment("scaleout.jobs_completed")
 
     # -- worker lifecycle (subclass seam: ProcessDistributedRunner spawns
     #    OS processes here instead of threads) ---------------------------
@@ -437,9 +442,22 @@ class DistributedRunner:
         for t in self._threads:
             t.join(timeout=5.0)
 
+    def _observe_heartbeats(self) -> None:
+        """Gauge per-worker heartbeat age (how stale each worker looks to
+        the master) — the signal the eviction sweep thresholds on."""
+        now = time.time()
+        for w in self.tracker.workers():
+            METRICS.gauge("scaleout.heartbeat_age_s." + w,
+                          round(now - self.tracker.last_heartbeat(w), 3))
+
     # -- master loop ----------------------------------------------------
     def run(self, max_wall_s: float = 300.0) -> Any:
+        with trace.span("scaleout.run", n_workers=self.n_workers):
+            return self._run(max_wall_s)
+
+    def _run(self, max_wall_s: float) -> Any:
         self.tracker.reset_done()    # a prior run's DONE must not no-op us
+        METRICS.increment("scaleout.runs")
         self._spawn_workers()
         deadline = time.time() + max_wall_s
         last_evict = time.time()
@@ -451,7 +469,12 @@ class DistributedRunner:
                 # eviction sweep (reference: every 60 s; scaled to poll rate);
                 # orphaned in-flight jobs are re-routed to live workers
                 if time.time() - last_evict > max(1.0, self.eviction_timeout_s / 2):
-                    _, orphans = self.tracker.evict_stale(self.eviction_timeout_s)
+                    self._observe_heartbeats()
+                    evicted, orphans = self.tracker.evict_stale(self.eviction_timeout_s)
+                    if evicted:
+                        METRICS.increment("scaleout.workers_evicted", len(evicted))
+                    if orphans:
+                        METRICS.increment("scaleout.jobs_requeued", len(orphans))
                     requeue.extend(orphans)
                     last_evict = time.time()
                 if self.router.send_work():
@@ -478,6 +501,7 @@ class DistributedRunner:
                         continue
                     job.worker_id = wid
                     self.tracker.add_job(job)
+                    METRICS.increment("scaleout.jobs_dispatched")
                     dispatched = True
                 if (not self.job_iterator.has_next()
                         and not requeue

@@ -20,6 +20,7 @@ ONE jitted train step over a `jax.sharding.Mesh`, batch sharded on the
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Iterable
 
@@ -33,6 +34,8 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..datasets.dataset import DataSet
+from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
+from ..observability import sample_device_memory, trace
 from ..optimize import transforms as tfm
 from .mesh import DP, local_mesh
 
@@ -148,31 +151,55 @@ class DataParallelTrainer:
 
     # ------------------------------------------------------------------ api
     def step(self, state: TrainState, x, y) -> tuple[TrainState, float]:
-        x = jnp.asarray(x)
-        y = jnp.asarray(y)
-        if x.shape[0] % self.n_dp != 0:
-            pad = self.n_dp - (x.shape[0] % self.n_dp)
-            idx = jnp.arange(pad) % x.shape[0]  # wrap: pad may exceed batch
-            x = jnp.concatenate([x, x[idx]])
-            y = jnp.concatenate([y, y[idx]])
-        state.key, sub = jax.random.split(state.key)
-        if self.router == "iterative_reduce":
-            if self._step_fn is None:
-                self._step_fn = self._build_sync_step()
-            params, tstate, loss = self._step_fn(
-                state.params, state.tstate, x, y, sub, jnp.asarray(state.step))
-            mean_loss = float(loss)
-        else:
-            if self._step_fn is None:
-                self._step_fn = self._build_local_step()
-                self._avg_fn = self._build_average()
-            keys = jax.random.split(sub, self.n_dp)
-            iters = jnp.full((self.n_dp,), state.step, jnp.int32)
-            params, tstate, losses = self._step_fn(
-                state.params, state.tstate, x, y, keys, iters)
-            if (state.step + 1) % self.average_every == 0:
-                params = self._avg_fn(params)
-            mean_loss = float(jnp.mean(losses))
+        # Observability is gated on one flag check: when disabled, no span
+        # object, no perf_counter read, no registry lock on this path.
+        obs = _obs_enabled()
+        first = self._step_fn is None  # first call pays trace+compile
+        t0 = time.perf_counter() if obs else 0.0
+        cm = trace.span("train_step.compile" if first else "train_step",
+                        step=state.step, router=self.router) if obs else NOOP_SPAN
+        with cm:
+            x = jnp.asarray(x)
+            y = jnp.asarray(y)
+            n_samples = x.shape[0]
+            if x.shape[0] % self.n_dp != 0:
+                pad = self.n_dp - (x.shape[0] % self.n_dp)
+                if obs:
+                    METRICS.increment("train_step.pad_batch")
+                    METRICS.increment("train_step.padded_samples", pad)
+                idx = jnp.arange(pad) % x.shape[0]  # wrap: pad may exceed batch
+                x = jnp.concatenate([x, x[idx]])
+                y = jnp.concatenate([y, y[idx]])
+            state.key, sub = jax.random.split(state.key)
+            if self.router == "iterative_reduce":
+                if first:
+                    self._step_fn = self._build_sync_step()
+                params, tstate, loss = self._step_fn(
+                    state.params, state.tstate, x, y, sub, jnp.asarray(state.step))
+                mean_loss = float(loss)
+            else:
+                if first:
+                    self._step_fn = self._build_local_step()
+                    self._avg_fn = self._build_average()
+                keys = jax.random.split(sub, self.n_dp)
+                iters = jnp.full((self.n_dp,), state.step, jnp.int32)
+                params, tstate, losses = self._step_fn(
+                    state.params, state.tstate, x, y, keys, iters)
+                if (state.step + 1) % self.average_every == 0:
+                    params = self._avg_fn(params)
+                    if obs:
+                        METRICS.increment("train_step.periodic_average")
+                mean_loss = float(jnp.mean(losses))
+        if obs:
+            dt = time.perf_counter() - t0
+            # compile-vs-execute split: the first call's wall time is
+            # dominated by trace+lower+compile — keep it out of the steady
+            # state histogram so p99 means what a dashboard thinks it means
+            METRICS.observe_time("train_step.compile" if first else "train_step", dt)
+            METRICS.increment("train_step.iterations")
+            METRICS.gauge("train_step.loss", mean_loss)
+            if dt > 0:
+                METRICS.gauge("train_step.samples_per_sec", n_samples / dt)
         return TrainState(params, tstate, state.step + 1, state.key), mean_loss
 
     def fit(self, state: TrainState, data: Iterable[DataSet] | DataSet,
@@ -189,20 +216,23 @@ class DataParallelTrainer:
         end); with ``resume`` (default) restores the latest checkpoint
         before training."""
         batches = [data] if isinstance(data, DataSet) else list(data)
-        if checkpoint_manager is not None and resume \
-                and checkpoint_manager.latest_step() is not None:
-            state = self.restore(state, checkpoint_manager)
-        losses = []
-        total = epochs * len(batches)
-        while state.step < total:
-            b = batches[state.step % len(batches)]
-            state, loss = self.step(state, b.features, b.labels)
-            losses.append(loss)
-            if (checkpoint_manager is not None and checkpoint_every > 0
-                    and state.step % checkpoint_every == 0):
+        with trace.span("trainer.fit", epochs=epochs, n_batches=len(batches),
+                        router=self.router):
+            if checkpoint_manager is not None and resume \
+                    and checkpoint_manager.latest_step() is not None:
+                state = self.restore(state, checkpoint_manager)
+            losses = []
+            total = epochs * len(batches)
+            while state.step < total:
+                b = batches[state.step % len(batches)]
+                state, loss = self.step(state, b.features, b.labels)
+                losses.append(loss)
+                if (checkpoint_manager is not None and checkpoint_every > 0
+                        and state.step % checkpoint_every == 0):
+                    self.checkpoint(state, checkpoint_manager)
+            if checkpoint_manager is not None and losses:
                 self.checkpoint(state, checkpoint_manager)
-        if checkpoint_manager is not None and losses:
-            self.checkpoint(state, checkpoint_manager)
+        sample_device_memory()  # HBM gauges; no-op on CPU / when disabled
         return state, losses
 
     # ------------------------------------------------------------------ ckpt

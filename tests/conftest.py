@@ -33,3 +33,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng_np():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """The metrics registry and tracer are process-global singletons —
+    wipe them (and restore the enable flag) after every test so counters
+    recorded by one test can't satisfy another's assertions."""
+    yield
+    from deeplearning4j_tpu import observability as obs
+
+    obs.enable()
+    obs.METRICS.reset()
+    obs.TRACER.clear()
+    obs.TRACER.stop_stream()

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Fetch /metrics + /status from a running job's StatusServer and render a
+human-readable table.
+
+Usage:
+    python tools/metrics_dump.py --port 8787 [--host 127.0.0.1]
+    python tools/metrics_dump.py --url http://10.0.0.3:8787
+    python tools/metrics_dump.py --port 8787 --prom   # raw Prometheus text
+
+No dependencies beyond stdlib: talks to the endpoints
+``deeplearning4j_tpu.observability.StatusServer`` serves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _fetch(base: str, path: str, timeout: float):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            body = r.read()
+    except (urllib.error.URLError, OSError) as e:
+        return None, f"{path}: {e}"
+    if path.endswith(".prom"):
+        return body.decode(), None
+    return json.loads(body), None
+
+
+def _rows(title: str, rows: list[tuple], headers: tuple) -> str:
+    """Plain-text table: header + aligned columns."""
+    out = [title]
+    if not rows:
+        out.append("  (none)")
+        return "\n".join(out)
+    cells = [tuple(str(c) for c in r) for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells))
+              for i, h in enumerate(headers)]
+    fmt = "  " + "  ".join(f"{{:<{w}}}" for w in widths)
+    out.append(fmt.format(*headers))
+    out.append("  " + "-" * (sum(widths) + 2 * (len(widths) - 1)))
+    out.extend(fmt.format(*r) for r in cells)
+    return "\n".join(out)
+
+
+def _fmt_s(v: float) -> str:
+    if v != v:
+        return "nan"
+    if v >= 1.0:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.2f}ms"
+
+
+def render_metrics(snap: dict) -> str:
+    parts = []
+    parts.append(_rows(
+        "counters", sorted(snap.get("counters", {}).items()),
+        ("name", "value")))
+    parts.append(_rows(
+        "gauges",
+        [(k, f"{v:.6g}") for k, v in sorted(snap.get("gauges", {}).items())],
+        ("name", "value")))
+    timer_rows = [
+        (name, s["count"], _fmt_s(s["mean_s"]), _fmt_s(s["p50_s"]),
+         _fmt_s(s["p95_s"]), _fmt_s(s["p99_s"]), _fmt_s(s["total_s"]))
+        for name, s in sorted(snap.get("timers", {}).items())]
+    parts.append(_rows(
+        "timers", timer_rows,
+        ("name", "count", "mean", "p50", "p95", "p99", "total")))
+    return "\n\n".join(parts)
+
+
+def render_status(status: dict) -> str:
+    if not status:
+        return "status: (no tracker attached)"
+    lines = ["status"]
+    hb = status.get("heartbeats_age_s", {})
+    enabled = status.get("enabled", {})
+    worker_rows = [(w, enabled.get(w, "?"), hb.get(w, "?"))
+                   for w in status.get("workers", [])]
+    lines.append(_rows("  workers", worker_rows,
+                       ("worker", "enabled", "heartbeat_age_s")))
+    for k in ("current_jobs", "pending_updates", "done"):
+        if k in status:
+            lines.append(f"  {k}: {status[k]}")
+    counters = status.get("counters", {})
+    if counters:
+        lines.append(_rows("  tracker counters", sorted(counters.items()),
+                           ("name", "value")))
+    for e in status.get("errors", []):
+        lines.append(f"  partial: {e}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int)
+    ap.add_argument("--url", help="full base URL (overrides --host/--port)")
+    ap.add_argument("--prom", action="store_true",
+                    help="dump raw Prometheus text exposition instead")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    if args.url:
+        base = args.url.rstrip("/")
+    elif args.port:
+        base = f"http://{args.host}:{args.port}"
+    else:
+        ap.error("need --port or --url")
+
+    if args.prom:
+        body, err = _fetch(base, "/metrics.prom", args.timeout)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        print(body, end="")
+        return 0
+
+    snap, err = _fetch(base, "/metrics", args.timeout)
+    if err:
+        print(err, file=sys.stderr)
+        return 1
+    print(render_metrics(snap))
+    status, err = _fetch(base, "/status", args.timeout)
+    print()
+    if err:
+        print(f"status unavailable ({err})")
+    else:
+        print(render_status(status))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
