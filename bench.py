@@ -134,7 +134,7 @@ def _discover_devices(attempts: int = None, timeout_s: float = None,
 
 
 def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
-                prefetch=False, metric=None):
+                prefetch=False, async_losses=False, metric=None):
     """Run ``iters`` steps rotating batches, syncing to host EVERY
     iteration.  Returns (iter_times, last_loss, params, opt) — params/opt
     are threaded back out because train steps donate their input buffers.
@@ -155,6 +155,14 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
     ``prefetch``: feed through ``prefetch_to_device`` (double-buffered
     async transfers) — the production input-pipeline number, between the
     serialized end-to-end upper bound and the staged pure-compute one.
+
+    ``async_losses``: the trainer's production mode — NO per-step sync;
+    losses stay on device and ONE ``jax.block_until_ready`` fence at the
+    end covers the whole run (device programs execute in dispatch order,
+    so the fenced losses cannot exist before every step executed — the
+    measurement stays physically sound, but only the caller's total wall
+    clock around the loop is meaningful; the per-iteration entries are
+    dispatch times and MUST NOT feed the MFU/consistency guards).
     """
     import jax
 
@@ -178,6 +186,18 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
             loss = float(np.asarray(loss))       # forced host sync
             record(time.perf_counter() - t0)
         return iter_times, loss, params, opt
+    if async_losses:
+        pending = []
+        for k in range(iters):
+            a, b = batches[k % len(batches)]
+            t0 = time.perf_counter()
+            if not stage_on_device:
+                a, b = jax.device_put(a), jax.device_put(b)
+            params, opt, loss = step(params, opt, a, b)
+            pending.append(loss)                 # stays on device
+            record(time.perf_counter() - t0)     # dispatch time only
+        jax.block_until_ready(pending)           # the single end fence
+        return iter_times, float(np.asarray(pending[-1])), params, opt
     for k in range(iters):
         a, b = batches[k % len(batches)]
         t0 = time.perf_counter()
@@ -350,6 +370,14 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         e2e_times, _, params, opt = _timed_loop(
             step, params, opt, batches, iters,
             metric="bench.bert_base.step_e2e")
+        # e2e again but the way the trainer actually runs it: no per-step
+        # loss sync, one fence at the end — only total wall clock (fence
+        # included) is a claim; dispatch times are recorded for diagnosis
+        al_wall0 = time.perf_counter()
+        al_times, _, params, opt = _timed_loop(
+            step, params, opt, batches, iters, async_losses=True,
+            metric="bench.bert_base.step_e2e_async_dispatch")
+        al_wall_s = time.perf_counter() - al_wall0
         # the prefetched leg's per-step timer starts AFTER the generator
         # pull, so device_put issuance hides outside it — also record the
         # whole-loop wall clock (includes every pull) alongside
@@ -371,11 +399,14 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         "attention_choice": attention_reason,
         "iter_times": iter_times, "stats": st,
         "e2e_stats": e2e, "prefetch_stats": pf,
+        "async_dispatch_stats": _stats(al_times),
         "tokens_per_sec": batch * seq / st["median_s"],
         "tokens_per_sec_e2e": batch * seq / e2e["median_s"],
         "tokens_per_sec_prefetched": batch * seq / pf["median_s"],
         "prefetch_wall_s_total": pf_wall_s,
         "tokens_per_sec_prefetched_wall": batch * seq * iters / pf_wall_s,
+        "async_wall_s_total": al_wall_s,
+        "tokens_per_sec_e2e_async": batch * seq * iters / al_wall_s,
         "flops_per_iter": cfg.flops_per_token() * batch * seq,
         "flops_per_token_analytic": cfg.flops_per_token(),
         "xla_flops_per_step": xla_flops,
@@ -733,6 +764,11 @@ def _registry_timers():
 
 def main():
     t_start = time.time()
+    # Persistent XLA compilation cache (repo-local, gitignored): the BERT
+    # leg's compile dominates bench wall time on reruns; cache hits skip it.
+    from deeplearning4j_tpu.parallel.compile_cache import setup_compile_cache
+    setup_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".cache", "xla"))
     devices, fallback_reason, probe_failures = _discover_devices()
     dev = devices[0]
     kind = getattr(dev, "device_kind", "cpu").lower()
@@ -851,6 +887,14 @@ def main():
                 bert["tokens_per_sec_prefetched_wall"], 1),
             "wall_ms_per_step": round(
                 bert["prefetch_wall_s_total"] / bert["iters"] * 1e3, 2)},
+        "e2e_async_losses": {
+            # wall-clock throughput incl. the single end fence — the
+            # lazy-loss win over e2e_with_transfers' per-step syncs
+            "tokens_per_sec_wall": round(bert["tokens_per_sec_e2e_async"], 1),
+            "wall_ms_per_step": round(
+                bert["async_wall_s_total"] / bert["iters"] * 1e3, 2),
+            "dispatch_ms_median": round(
+                bert["async_dispatch_stats"]["median_s"] * 1e3, 2)},
         "loss": round(bert["last_loss"], 4),
         **({"hbm_fallback": bert["hbm_fallback"]}
            if "hbm_fallback" in bert else {}),

@@ -329,7 +329,116 @@ class MovingWindowDataSetIterator(_IterBase):
         return iter(self._list)
 
 
-def prefetch_to_device(iterable, size: int = 2, sharding=None):
+def _device_put_tree(batch, sharding):
+    """``jax.device_put`` every array leaf of ``batch`` (non-array leaves —
+    e.g. the python-int sample counts the trainer threads alongside padded
+    batches — pass through untouched)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(batch)
+    leaves = [jax.device_put(x, sharding) if hasattr(x, "shape") else x
+              for x in leaves]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class _ThreadedPrefetch:
+    """Background-thread variant of :func:`prefetch_to_device`.
+
+    A daemon worker pulls from the source iterable, stages each batch on
+    device, and parks it in a bounded queue; the consumer thread never
+    blocks on *host-side* batch production (augmentation, parsing, a
+    generator doing real work).  The device transfers themselves are still
+    async jax transfers.
+
+    Lifecycle contract (what the tests pin down): the worker exits on
+    source exhaustion, on worker error (re-raised in the consumer), and on
+    ``close()`` — it must never outlive the iterator, even when the
+    consumer abandons iteration mid-stream with a full queue.
+    """
+
+    _DONE = object()  # sentinel: source exhausted
+
+    def __init__(self, iterable, size: int, sharding):
+        import queue as _queue
+        import threading
+
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, size))
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._source = iterable
+        self._sharding = sharding
+        self.thread = threading.Thread(
+            target=self._work, name="prefetch_to_device", daemon=True)
+        self.thread.start()
+
+    def _work(self):
+        try:
+            for batch in self._source:
+                if self._stop.is_set():
+                    return
+                staged = _device_put_tree(batch, self._sharding)
+                # stop-aware put: a plain blocking put on a full queue
+                # would deadlock close() when the consumer walked away
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.05)
+                        break
+                    except Exception:  # queue.Full
+                        continue
+        except BaseException as e:  # noqa: BLE001 - re-raised at consumer
+            self._error = e
+        finally:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._DONE, timeout=0.05)
+                    break
+                except Exception:  # queue.Full
+                    continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._error is not None:
+                err, self._error = self._error, None
+                self.close()
+                raise err
+            try:
+                item = self._q.get(timeout=0.05)
+            except Exception:  # queue.Empty — re-check error/stop, wait on
+                if not self.thread.is_alive() and self._q.empty() \
+                        and self._error is None:
+                    raise StopIteration from None
+                continue
+            if item is self._DONE:
+                if self._error is not None:
+                    continue  # surface the error on the next spin
+                self.close()
+                raise StopIteration
+            return item
+
+    def close(self):
+        """Stop the worker and join it (idempotent)."""
+        self._stop.set()
+        # drain so a worker blocked in put() sees the stop flag promptly
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:  # queue.Empty
+            pass
+        if self.thread.is_alive():
+            self.thread.join(timeout=5.0)
+
+    def __del__(self):  # best effort — close() is the contract
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+
+def prefetch_to_device(iterable, size: int = 2, sharding=None,
+                       host_thread: bool = False):
     """Double-buffered host->device staging (SURVEY §7 L3: "double-buffered
     host->device transfer"; the role the reference fills with its fetcher
     cursor + Akka batch actor hand-off).
@@ -339,28 +448,32 @@ def prefetch_to_device(iterable, size: int = 2, sharding=None):
     overlaps the device compute of batch k without any helper thread.
     Works on (features, labels) tuples, DataSets, or any pytree of host
     arrays; ``sharding`` (e.g. a NamedSharding) places each leaf when given.
+
+    With ``host_thread=True`` a daemon worker additionally overlaps
+    *producing* the batches (generator work: parsing, augmentation,
+    padding) with device compute — use when the source iterable itself is
+    expensive.  Returns a :class:`_ThreadedPrefetch` (iterable, plus
+    ``close()`` for deterministic shutdown); the default stays threadless.
     """
-    import collections
+    if host_thread:
+        return _ThreadedPrefetch(iterable, size, sharding)
 
-    import jax
+    def _threadless():
+        import collections
 
-    def put(batch):
-        leaves, treedef = jax.tree.flatten(batch)
-        leaves = [jax.device_put(x, sharding) if hasattr(x, "shape") else x
-                  for x in leaves]
-        return jax.tree.unflatten(treedef, leaves)
-
-    queue = collections.deque()
-    it = iter(iterable)
-    try:
-        while len(queue) < max(1, size):
-            queue.append(put(next(it)))
-    except StopIteration:
-        pass
-    while queue:
-        out = queue.popleft()
+        queue = collections.deque()
+        it = iter(iterable)
         try:
-            queue.append(put(next(it)))
+            while len(queue) < max(1, size):
+                queue.append(_device_put_tree(next(it), sharding))
         except StopIteration:
             pass
-        yield out
+        while queue:
+            out = queue.popleft()
+            try:
+                queue.append(_device_put_tree(next(it), sharding))
+            except StopIteration:
+                pass
+            yield out
+
+    return _threadless()

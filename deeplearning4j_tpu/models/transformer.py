@@ -37,8 +37,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - older jax spells the flag check_rep
+    from functools import wraps
+
+    from jax.experimental.shard_map import shard_map as _sm_old
+
+    @wraps(_sm_old)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _sm_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_vma)
 
 from ..parallel.mesh import DP, SP, TP
 
@@ -964,18 +971,22 @@ class TransformerLM:
                                     data_cursor=int(opt[0]))
 
         losses = []
+        start = int(opt[0])
         total = epochs * len(batches)
         # double-buffered host->device staging: the device_put of batch k+1
         # overlaps the step on batch k (async transfers), resuming from the
         # checkpointed cursor
         from ..datasets.iterator import prefetch_to_device
-        feed = (batches[k % len(batches)] for k in range(int(opt[0]), total))
+        feed = (batches[k % len(batches)] for k in range(start, total))
+        done = 0  # host-side mirror of opt[0]: reading it back would sync
         for a, b in prefetch_to_device(feed, size=2):
             params, opt, loss = step_fn(params, opt, a, b)
-            losses.append(float(loss))
+            losses.append(loss)  # stays on device; resolved once below
+            done += 1
             if (checkpoint_manager is not None and checkpoint_every > 0
-                    and int(opt[0]) % checkpoint_every == 0):
-                save()
+                    and (start + done) % checkpoint_every == 0):
+                save()  # CheckpointManager.save fences params/opt itself
+        losses = [float(l) for l in jax.block_until_ready(losses)]
         if checkpoint_manager is not None and losses:
             save()
         return params, opt, losses

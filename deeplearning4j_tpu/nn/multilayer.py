@@ -39,6 +39,7 @@ from ..datasets.dataset import DataSet, to_outcome_matrix
 from ..evaluation import Evaluation
 from ..observability import METRICS, enabled as _obs_enabled, trace
 from ..optimize import transforms as tfm
+from ..parallel.compile_cache import setup_compile_cache
 from ..utils import tree_math as tm
 from .conf import LayerKind, MultiLayerConfiguration, OptimizationAlgorithm
 from .layers import (
@@ -65,7 +66,7 @@ PREPROCESSORS: dict[str, Callable] = {
 class MultiLayerNetwork:
     """Layer stack + training orchestration."""
 
-    def __init__(self, conf: MultiLayerConfiguration):
+    def __init__(self, conf: MultiLayerConfiguration, *, score_every: int = 1):
         self.conf = conf
         self.layers: list[Layer] = [create_layer(c) for c in conf.confs]
         self.params: Params | None = None
@@ -73,6 +74,13 @@ class MultiLayerNetwork:
         self.listeners: list = []
         self._jit_cache: dict = {}
         self._score = float("nan")
+        # How often pretrain/finetune sync the on-device loss into the host
+        # ``_score`` float.  1 (default) keeps the reference per-iteration
+        # behavior; larger values keep the hot loop asynchronous — jax only
+        # runs ahead of the device if nothing forces a device->host read.
+        # The final iteration always syncs, so ``score()`` stays correct.
+        self.score_every = max(1, int(score_every))
+        setup_compile_cache()  # persistent XLA cache (env-gated no-op)
 
     # ------------------------------------------------------------------ init
     def init(self, key=None) -> Params:
@@ -175,6 +183,7 @@ class MultiLayerNetwork:
                 step = self._pretrain_step(i, layer, transform)
                 lparams = self.params[i]
                 tstate = transform.init(lparams)
+                loss = None
                 for b, batch in enumerate(batches):
                     x = jnp.asarray(batch.features)
                     # inputs to layer i are fixed while layer i trains
@@ -183,6 +192,11 @@ class MultiLayerNetwork:
                         key, sub = jax.random.split(key)
                         lparams, tstate, loss = step(lparams, tstate, inp, sub,
                                                      jnp.asarray(it))
+                    # syncing the score is a device->host read; keep it off
+                    # the hot loop unless asked for every batch
+                    if (b + 1) % self.score_every == 0:
+                        self._score = float(loss)
+                if loss is not None:
                     self._score = float(loss)
                 new_params = list(self.params)
                 new_params[i] = lparams
@@ -241,6 +255,8 @@ class MultiLayerNetwork:
         tstate = (self._tstates if self._tstates is not None
                   else transform.init(self.params))
         it = 0
+        loss = None
+        n_total = len(batches) * max(1, out_conf.num_iterations)
         for batch in batches:
             x, y = jnp.asarray(batch.features), jnp.asarray(batch.labels)
             for _ in range(max(1, out_conf.num_iterations)):
@@ -255,12 +271,18 @@ class MultiLayerNetwork:
                     self.params, tstate, x, y, sub, jnp.asarray(it))
                 self._tstates = tstate
                 it += 1
-                self._score = float(loss)
+                # ``float(loss)`` is a device->host sync that stalls jax's
+                # async dispatch; only pay it every ``score_every`` steps
+                # (and on the last step, so ``score()``/the loss gauge end
+                # correct).  score_every=1 is exactly the old behavior.
+                if it % self.score_every == 0 or it == n_total:
+                    self._score = float(loss)
+                    if obs:
+                        METRICS.gauge("multilayer.loss", self._score)
                 if obs:
                     METRICS.observe_time("multilayer.fit_iteration",
                                          time.perf_counter() - t0)
                     METRICS.increment("multilayer.iterations")
-                    METRICS.gauge("multilayer.loss", self._score)
                 for l in self.listeners:
                     l.iteration_done(self, it)
 

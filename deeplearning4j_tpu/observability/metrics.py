@@ -155,6 +155,21 @@ class MetricsRegistry:
                 h = self.timers[name] = Histogram(buckets or DEFAULT_TIME_BUCKETS)
             h.observe(seconds)
 
+    def observe_many(self, name: str, values: Iterable[float],
+                     buckets: Iterable[float] | None = None) -> None:
+        """Record a batch of timing observations under ONE lock acquisition —
+        the resolution-point companion to ``observe_time``: the async trainer
+        publishes a whole window of amortized step times at once when it
+        fences, and should not take the registry lock per entry."""
+        if not core.enabled():
+            return
+        with self._lock:
+            h = self.timers.get(name)
+            if h is None:
+                h = self.timers[name] = Histogram(buckets or DEFAULT_TIME_BUCKETS)
+            for v in values:
+                h.observe(v)
+
     def time(self, name: str):
         """Context manager timing its body into the ``name`` histogram."""
         if not core.enabled():

@@ -17,13 +17,14 @@ supersteps + ZooKeeper config, SURVEY.md §2.3) collapses on TPU into:
   cursor (exceeds the reference, which only java-serializes params).
 """
 
+from .compile_cache import setup_compile_cache
 from .mesh import MeshSpec, local_mesh, make_mesh
-from .trainer import DataParallelTrainer, TrainState
+from .trainer import DataParallelTrainer, LazyLoss, TrainState
 from .checkpoint import CheckpointManager
 from .driver import Driver
 
 __all__ = [
     "MeshSpec", "local_mesh", "make_mesh",
-    "DataParallelTrainer", "TrainState",
-    "CheckpointManager", "Driver",
+    "DataParallelTrainer", "LazyLoss", "TrainState",
+    "CheckpointManager", "Driver", "setup_compile_cache",
 ]

@@ -60,6 +60,12 @@ class CheckpointManager:
              data_cursor: int = 0, extra: dict | None = None) -> Path:
         with trace.span("checkpoint.save", step=step), \
                 METRICS.time("checkpoint.save"):
+            # Fence before reading: under async dispatch the caller's latest
+            # step may still be executing — np.asarray on an in-flight array
+            # would block leaf-by-leaf mid-flatten; one explicit barrier up
+            # front snapshots a consistent state.  (The trainer additionally
+            # resolves its pending-loss ring before calling save.)
+            jax.block_until_ready((params, tstate))
             path = self._save(step, params, tstate, key, data_cursor, extra)
         METRICS.increment("checkpoint.saves")
         return path

@@ -69,8 +69,10 @@ class Driver:
         configured); returns the final state and per-step losses."""
         with trace.span("driver.run", epochs=epochs):
             state = self.trainer.init_state(params, key=key)
+            # fit streams any iterable — no list() materialization; one-shot
+            # generators make a single pass (multi-epoch needs re-iterables)
             state, losses = self.trainer.fit(
-                state, list(batches), epochs=epochs,
+                state, batches, epochs=epochs,
                 checkpoint_manager=self.checkpoint_manager,
                 checkpoint_every=self.checkpoint_every, resume=resume)
         METRICS.increment("driver.steps", len(losses))

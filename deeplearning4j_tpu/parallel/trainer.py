@@ -1,4 +1,4 @@
-"""SPMD data-parallel trainer.
+"""SPMD data-parallel trainer with an asynchronous, pipelined hot loop.
 
 TPU-native replacement for the reference's scaleout training loop
 (master/worker actors + StateTracker + WorkRouter policy, SURVEY.md §3.3):
@@ -15,13 +15,34 @@ ONE jitted train step over a `jax.sharding.Mesh`, batch sharded on the
   K local steps with NO cross-device traffic, then average with one
   in-compiled `pmean` (``shard_map``).  K=1 degenerates to iterative-reduce.
   Deviation documented per SURVEY.md §7 hard-part #5.
+
+Async execution model (DESIGN.md §10): JAX dispatch is asynchronous, so the
+Python driver only stays ahead of the device if nothing on the hot loop
+forces a device->host read.  Three rules enforce that here:
+
+1. ``step`` never calls ``float(loss)`` — it returns a :class:`LazyLoss`
+   handle and parks the device scalar on a bounded pending ring; ``fit``
+   resolves the ring in batches (every ``resolve_every`` steps and at the
+   end) behind one explicit ``block_until_ready`` fence.  Loss/throughput
+   gauges move to the resolution point so metrics stay correct without
+   re-introducing the per-step sync.
+2. Ragged batches pad to a small powers-of-two bucket ladder (capped at
+   the nominal batch) with one jitted step per bucket and a
+   ``train_step.recompile`` counter — bounded compilation instead of one
+   recompile per odd shape.  A validity mask keeps the loss/gradient
+   average EXACT under padding (padded rows contribute zero).
+3. ``fit`` streams any iterable (no ``list(data)`` materialization) and
+   routes host batches through ``prefetch_to_device`` with the trainer's
+   ``NamedSharding``, so H2D transfer overlaps device compute.
+
+Checkpoints fence the ring before reading params (``checkpoint``), so a
+snapshot never races in-flight steps.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from functools import partial
 from typing import Any, Callable, Iterable
 
 import jax
@@ -31,15 +52,67 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 try:
     from jax import shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # the experimental API spells the flag check_rep
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 
 from ..datasets.dataset import DataSet
 from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
 from ..observability import sample_device_memory, trace
 from ..optimize import transforms as tfm
+from .compile_cache import setup_compile_cache
 from .mesh import DP, local_mesh
 
 LossFn = Callable[..., jnp.ndarray]  # (params, x, y, key) -> scalar
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+class LazyLoss:
+    """Lazy handle to a device-resident loss.
+
+    ``step`` returns one of these instead of a synced float: the scalar
+    stays on device until ``float(handle)`` / ``handle.value()`` forces
+    the device->host read, so the dispatch loop never blocks on it.
+    ``block()`` waits for the device value without converting it (the
+    fence primitive ``fit`` uses).  Hogwild steps carry a per-replica
+    loss vector; ``value()`` reduces it to the replica mean.
+    """
+
+    __slots__ = ("_dev", "_value")
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._value: float | None = None
+
+    @property
+    def resolved(self) -> bool:
+        return self._value is not None
+
+    def block(self) -> "LazyLoss":
+        if self._value is None:
+            jax.block_until_ready(self._dev)
+        return self
+
+    def value(self) -> float:
+        if self._value is None:
+            self._value = float(np.mean(jax.device_get(self._dev)))
+            self._dev = None
+        return self._value
+
+    __float__ = value
+
+    def __format__(self, spec: str) -> str:
+        return format(self.value(), spec)
+
+    def __repr__(self) -> str:
+        return (f"LazyLoss({self._value!r})" if self.resolved
+                else "LazyLoss(<pending>)")
 
 
 @dataclasses.dataclass
@@ -51,11 +124,17 @@ class TrainState:
 
 
 class DataParallelTrainer:
-    """Shard a supervised train step over the ``dp`` axis of a mesh."""
+    """Shard a supervised train step over the ``dp`` axis of a mesh.
+
+    ``max_pending`` bounds the ring of unresolved losses: when a caller
+    drives ``step`` directly and never resolves, the trainer fences
+    itself every ``max_pending`` dispatches so the device queue cannot
+    grow without bound.
+    """
 
     def __init__(self, loss_fn: LossFn, transform: tfm.GradientTransform,
                  mesh: Mesh | None = None, router: str = "iterative_reduce",
-                 average_every: int = 8):
+                 average_every: int = 8, max_pending: int = 64):
         if router not in ("iterative_reduce", "hogwild"):
             raise ValueError(f"unknown router {router!r}")
         self.loss_fn = loss_fn
@@ -63,9 +142,16 @@ class DataParallelTrainer:
         self.mesh = mesh if mesh is not None else local_mesh()
         self.router = router
         self.average_every = average_every
+        self.max_pending = max(1, max_pending)
         self.n_dp = self.mesh.shape[DP]
-        self._step_fn = None
         self._avg_fn = None
+        # bucketed jit cache: one compiled step per padded batch size
+        self._step_cache: dict[int, Any] = {}
+        self._nominal: int | None = None
+        # pending-loss ring: (LazyLoss, n_real_samples) awaiting resolution
+        self._pending: list[tuple[LazyLoss, int]] = []
+        self._window_t0: float | None = None
+        setup_compile_cache()  # persistent XLA cache (env-gated no-op)
 
     # ------------------------------------------------------------------ state
     def init_state(self, params, key=None) -> TrainState:
@@ -92,21 +178,78 @@ class DataParallelTrainer:
             tstate = jax.device_put(tstate, NamedSharding(self.mesh, P(DP)))
         return TrainState(params=params, tstate=tstate, step=0, key=key)
 
+    # ------------------------------------------------------------------ buckets
+    def _bucket_size(self, n: int) -> int:
+        """Padded size for a batch of ``n``: powers-of-two ladder rounded to
+        the dp width, capped at the nominal (first-seen) batch size.  Bounds
+        the number of compiled step variants at ~log2(nominal)."""
+        if self._nominal is None:
+            self._nominal = _round_up(n, self.n_dp)
+        cap = self._nominal
+        if n >= cap:
+            return _round_up(n, self.n_dp)
+        b = 1
+        while b < n:
+            b <<= 1
+        return min(_round_up(b, self.n_dp), cap)
+
+    def _pad_to_bucket(self, x, y):
+        """Host-side pad to the bucket size.  Returns (x, y, n_valid, bucket).
+
+        Wrap indices are built with ``np.arange`` — constructing padding
+        indices must not launch a device computation.  The padded rows are
+        masked out inside the jitted step, so the loss/gradient average
+        stays exact regardless of what the pad rows contain.
+        """
+        n = int(np.shape(x)[0])
+        bucket = self._bucket_size(n)
+        pad = bucket - n
+        if pad:
+            if _obs_enabled():
+                METRICS.increment("train_step.pad_batch")
+                METRICS.increment("train_step.padded_samples", pad)
+            idx = np.arange(pad) % n  # wrap: pad may exceed batch
+            lib = jnp if isinstance(x, jnp.ndarray) else np
+            x = lib.concatenate([x, x[idx]])
+            y = lib.concatenate([y, y[idx]])
+        return x, y, n, bucket
+
     # ------------------------------------------------------------------ steps
+    def _masked_mean_loss(self, key_select):
+        """Wrap ``loss_fn`` (a per-sample mean) into an exact masked mean:
+        per-example losses via a singleton-batch vmap, zero weight for
+        padded rows, normalized by the REAL sample count.  Decomposable
+        (per-row) losses — every loss in this repo — are exact under this
+        rewrite; batch-coupled losses (cross-batch statistics) are not and
+        should avoid ragged batches."""
+        loss_fn = self.loss_fn
+
+        def masked(params, x, y, key, mask, denom):
+            per = jax.vmap(
+                lambda xi, yi: loss_fn(params, xi[None], yi[None],
+                                       key_select(key)))(x, y)
+            per = per.reshape((x.shape[0],))
+            return jnp.sum(per * mask.astype(per.dtype)) / denom.astype(per.dtype)
+
+        return masked
+
     def _build_sync_step(self):
         mesh = self.mesh
         batch_sh = NamedSharding(mesh, P(DP))
         rep = NamedSharding(mesh, P())
+        masked = self._masked_mean_loss(lambda k: k)
 
-        def step(params, tstate, x, y, key, iteration):
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, x, y, key)
+        def step(params, tstate, x, y, key, iteration, n_valid):
+            mask = jnp.arange(x.shape[0]) < n_valid
+            loss, grads = jax.value_and_grad(masked)(
+                params, x, y, key, mask, n_valid)
             updates, tstate = self.transform.update(grads, tstate, params, iteration)
             params = tfm.apply_updates(params, updates)
             return params, tstate, loss
 
         return jax.jit(
             step,
-            in_shardings=(rep, rep, batch_sh, batch_sh, rep, rep),
+            in_shardings=(rep, rep, batch_sh, batch_sh, rep, rep, rep),
             out_shardings=(rep, rep, rep),
             donate_argnums=(0, 1),
         )
@@ -114,13 +257,19 @@ class DataParallelTrainer:
     def _build_local_step(self):
         """HogWild-approx local step: runs independently per dp shard."""
         mesh = self.mesh
+        masked = self._masked_mean_loss(lambda k: k[0])
 
-        def local(params, tstate, x, y, key, iteration):
+        def local(params, tstate, x, y, key, iteration, n_valid):
             # leading dp axis stripped by shard_map (shard size 1) -> squeeze
             params = jax.tree_util.tree_map(lambda a: a[0], params)
             tstate = jax.tree_util.tree_map(
                 lambda a: a[0] if isinstance(a, jnp.ndarray) else a, tstate)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, x, y, key[0])
+            # global row ids of this shard's slice -> local validity mask
+            rows = jax.lax.axis_index(DP) * x.shape[0] + jnp.arange(x.shape[0])
+            mask = rows < n_valid[0]
+            denom = jnp.maximum(jnp.sum(mask), 1)  # all-pad shard guard
+            loss, grads = jax.value_and_grad(masked)(
+                params, x, y, key, mask, denom)
             updates, tstate = self.transform.update(grads, tstate, params, iteration[0])
             params = tfm.apply_updates(params, updates)
             expand = lambda a: a[None] if isinstance(a, jnp.ndarray) else a
@@ -129,7 +278,7 @@ class DataParallelTrainer:
 
         smapped = shard_map(
             local, mesh=mesh,
-            in_specs=(P(DP), P(DP), P(DP), P(DP), P(DP), P(DP)),
+            in_specs=(P(DP), P(DP), P(DP), P(DP), P(DP), P(DP), P(DP)),
             out_specs=(P(DP), P(DP), P(DP)),
             check_vma=False,
         )
@@ -149,87 +298,181 @@ class DataParallelTrainer:
             avg, mesh=mesh, in_specs=(P(DP),), out_specs=P(DP),
             check_vma=False))
 
+    def _step_for(self, bucket: int):
+        fn = self._step_cache.get(bucket)
+        if fn is None:
+            # one compiled variant per bucket — the counter the perf smoke
+            # asserts on: steady-state recompiles == buckets used
+            METRICS.increment("train_step.recompile")
+            if self.router == "iterative_reduce":
+                fn = self._build_sync_step()
+            else:
+                fn = self._build_local_step()
+                if self._avg_fn is None:
+                    self._avg_fn = self._build_average()
+            self._step_cache[bucket] = fn
+        return fn
+
     # ------------------------------------------------------------------ api
-    def step(self, state: TrainState, x, y) -> tuple[TrainState, float]:
+    def step(self, state: TrainState, x, y) -> tuple[TrainState, LazyLoss]:
+        """Dispatch one step; returns the new state and a :class:`LazyLoss`.
+
+        The loss handle is float-compatible (``float(loss)`` forces the
+        device->host sync) but the hot loop should leave resolution to
+        ``fit``'s batched fences.
+        """
+        x, y, n_valid, bucket = self._pad_to_bucket(x, y)
+        return self._dispatch(state, x, y, n_valid, bucket)
+
+    def _dispatch(self, state: TrainState, x, y, n_valid: int,
+                  bucket: int) -> tuple[TrainState, LazyLoss]:
         # Observability is gated on one flag check: when disabled, no span
         # object, no perf_counter read, no registry lock on this path.
         obs = _obs_enabled()
-        first = self._step_fn is None  # first call pays trace+compile
+        first = bucket not in self._step_cache  # first call pays trace+compile
         t0 = time.perf_counter() if obs else 0.0
         cm = trace.span("train_step.compile" if first else "train_step",
                         step=state.step, router=self.router) if obs else NOOP_SPAN
         with cm:
-            x = jnp.asarray(x)
-            y = jnp.asarray(y)
-            n_samples = x.shape[0]
-            if x.shape[0] % self.n_dp != 0:
-                pad = self.n_dp - (x.shape[0] % self.n_dp)
-                if obs:
-                    METRICS.increment("train_step.pad_batch")
-                    METRICS.increment("train_step.padded_samples", pad)
-                idx = jnp.arange(pad) % x.shape[0]  # wrap: pad may exceed batch
-                x = jnp.concatenate([x, x[idx]])
-                y = jnp.concatenate([y, y[idx]])
+            step_fn = self._step_for(bucket)
             state.key, sub = jax.random.split(state.key)
             if self.router == "iterative_reduce":
-                if first:
-                    self._step_fn = self._build_sync_step()
-                params, tstate, loss = self._step_fn(
-                    state.params, state.tstate, x, y, sub, jnp.asarray(state.step))
-                mean_loss = float(loss)
+                params, tstate, loss = step_fn(
+                    state.params, state.tstate, x, y, sub,
+                    jnp.asarray(state.step), jnp.asarray(n_valid, jnp.int32))
             else:
-                if first:
-                    self._step_fn = self._build_local_step()
-                    self._avg_fn = self._build_average()
                 keys = jax.random.split(sub, self.n_dp)
                 iters = jnp.full((self.n_dp,), state.step, jnp.int32)
-                params, tstate, losses = self._step_fn(
-                    state.params, state.tstate, x, y, keys, iters)
+                nv = jnp.full((self.n_dp,), n_valid, jnp.int32)
+                params, tstate, loss = step_fn(
+                    state.params, state.tstate, x, y, keys, iters, nv)
                 if (state.step + 1) % self.average_every == 0:
                     params = self._avg_fn(params)
                     if obs:
                         METRICS.increment("train_step.periodic_average")
-                mean_loss = float(jnp.mean(losses))
+        lazy = LazyLoss(loss)
         if obs:
             dt = time.perf_counter() - t0
             # compile-vs-execute split: the first call's wall time is
             # dominated by trace+lower+compile — keep it out of the steady
-            # state histogram so p99 means what a dashboard thinks it means
+            # state histogram so p99 means what a dashboard thinks it means.
+            # Steady-state entries time DISPATCH only (the loop is async);
+            # execution time lands in train_step.execute at resolution.
             METRICS.observe_time("train_step.compile" if first else "train_step", dt)
             METRICS.increment("train_step.iterations")
-            METRICS.gauge("train_step.loss", mean_loss)
-            if dt > 0:
-                METRICS.gauge("train_step.samples_per_sec", n_samples / dt)
-        return TrainState(params, tstate, state.step + 1, state.key), mean_loss
+        if not self._pending:
+            self._window_t0 = t0 if obs else time.perf_counter()
+        self._pending.append((lazy, n_valid))
+        if len(self._pending) >= self.max_pending:
+            self._resolve_pending()  # ring full: self-fence (bounded queue)
+        return TrainState(params, tstate, state.step + 1, state.key), lazy
+
+    def _resolve_pending(self) -> list[float]:
+        """Fence: block until every pending loss is on host, then publish
+        the window's metrics in one batch (gauges/histograms move HERE so
+        the dispatch loop never syncs)."""
+        if not self._pending:
+            return []
+        entries, self._pending = self._pending, []
+        obs = _obs_enabled()
+        wait0 = time.perf_counter() if obs else 0.0
+        # one fence suffices: device programs execute in dispatch order, so
+        # the last loss being ready implies the whole window has executed
+        entries[-1][0].block()
+        vals = [lazy.value() for lazy, _ in entries]
+        if obs:
+            now = time.perf_counter()
+            METRICS.observe_time("train_step.resolve_wait", now - wait0)
+            METRICS.increment("train_step.losses_resolved", len(vals))
+            METRICS.gauge("train_step.loss", vals[-1])
+            t0 = self._window_t0
+            if t0 is not None and now > t0:
+                window = now - t0
+                n_samples = sum(n for _, n in entries)
+                METRICS.gauge("train_step.samples_per_sec", n_samples / window)
+                # amortized per-step execution time over the async window —
+                # the steady-state throughput histogram (dispatch times in
+                # `train_step` no longer measure execution)
+                METRICS.observe_many(
+                    "train_step.execute", [window / len(entries)] * len(entries))
+        self._window_t0 = None
+        return vals
+
+    # ------------------------------------------------------------------ fit
+    def _host_stream(self, data, epochs: int, skip: int, prefetch_size: int):
+        """Stream (x, y, n_valid, bucket) tuples: host-side bucket padding,
+        then double-buffered device transfer via ``prefetch_to_device``
+        with this trainer's batch sharding — H2D overlaps compute on the
+        production path, not just in bench.  Accepts a DataSet, a sequence,
+        a DataSetIterator, or a one-shot generator (no ``list(data)``);
+        re-iterable inputs replay for ``epochs``, one-shot generators
+        stream a single pass."""
+        if isinstance(data, DataSet):
+            data = (data,)
+
+        def batches():
+            idx = 0
+            for _ in range(max(1, int(epochs))):
+                for b in iter(data):
+                    if idx < skip:  # checkpoint-resume cursor
+                        idx += 1
+                        continue
+                    idx += 1
+                    x, y = ((b.features, b.labels)
+                            if hasattr(b, "features") else (b[0], b[1]))
+                    if not isinstance(x, jnp.ndarray):
+                        x, y = np.asarray(x), np.asarray(y)
+                    yield self._pad_to_bucket(x, y)
+
+        if prefetch_size <= 0:
+            return batches()
+        from ..datasets.iterator import prefetch_to_device
+        return prefetch_to_device(batches(), size=prefetch_size,
+                                  sharding=NamedSharding(self.mesh, P(DP)))
 
     def fit(self, state: TrainState, data: Iterable[DataSet] | DataSet,
             epochs: int = 1, *, checkpoint_manager=None,
             checkpoint_every: int = 0, resume: bool = True,
+            async_dispatch: bool = True, resolve_every: int = 32,
+            prefetch_size: int = 2,
             ) -> tuple[TrainState, list[float]]:
-        """Run to ``epochs * n_batches`` total steps, counting from
+        """Run ``epochs`` passes over ``data``, counting steps from
         ``state.step`` — so a state restored from a checkpoint continues
         where it left off (the elastic-recovery resume path; the reference
         only ever re-loaded bare params, ``ModelSavingActor.java:75-79``).
 
+        ``data`` may be any iterable of batches and is never materialized;
+        batches flow host-pad -> prefetch double-buffer -> jitted step.
+        With ``async_dispatch`` (default) losses resolve in batches every
+        ``resolve_every`` steps behind one ``block_until_ready`` fence;
+        ``async_dispatch=False`` is the synchronous per-step reference
+        path (same compiled steps, same numbers — used by the parity
+        tests).  Returned losses are resolved floats either way.
+
         With ``checkpoint_manager`` set, auto-saves params + transform state
         + RNG key + data cursor every ``checkpoint_every`` steps (and at the
-        end); with ``resume`` (default) restores the latest checkpoint
-        before training."""
-        batches = [data] if isinstance(data, DataSet) else list(data)
-        with trace.span("trainer.fit", epochs=epochs, n_batches=len(batches),
+        end) — each save fences pending steps first; with ``resume``
+        (default) restores the latest checkpoint before training."""
+        n_known = len(data) if hasattr(data, "__len__") else -1
+        with trace.span("trainer.fit", epochs=epochs, n_batches=n_known,
                         router=self.router):
             if checkpoint_manager is not None and resume \
                     and checkpoint_manager.latest_step() is not None:
                 state = self.restore(state, checkpoint_manager)
-            losses = []
-            total = epochs * len(batches)
-            while state.step < total:
-                b = batches[state.step % len(batches)]
-                state, loss = self.step(state, b.features, b.labels)
-                losses.append(loss)
+            handles: list[LazyLoss] = []
+            for x, y, n_valid, bucket in self._host_stream(
+                    data, epochs, state.step, prefetch_size):
+                state, lazy = self._dispatch(state, x, y, n_valid, bucket)
+                handles.append(lazy)
+                if not async_dispatch:
+                    self._resolve_pending()  # sync reference path
+                elif resolve_every and len(self._pending) >= resolve_every:
+                    self._resolve_pending()
                 if (checkpoint_manager is not None and checkpoint_every > 0
                         and state.step % checkpoint_every == 0):
                     self.checkpoint(state, checkpoint_manager)
+            self._resolve_pending()
+            losses = [h.value() for h in handles]
             if checkpoint_manager is not None and losses:
                 self.checkpoint(state, checkpoint_manager)
         sample_device_memory()  # HBM gauges; no-op on CPU / when disabled
@@ -237,6 +480,11 @@ class DataParallelTrainer:
 
     # ------------------------------------------------------------------ ckpt
     def checkpoint(self, state: TrainState, manager) -> None:
+        """Fence-then-save: resolve the pending-loss ring and block on the
+        state itself so the snapshot cannot race in-flight steps."""
+        self._resolve_pending()
+        jax.block_until_ready((state.params, state.tstate))
+        METRICS.increment("checkpoint.fences")
         manager.save(state.step, state.params, tstate=state.tstate,
                      key=state.key, data_cursor=state.step)
 

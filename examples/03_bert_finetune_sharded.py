@@ -44,10 +44,13 @@ def main():
     tokens = jax.random.randint(jax.random.key(3), (32, 32), 0, cfg.vocab_size)
     labels = jnp.any(tokens == 7, axis=1).astype(jnp.int32)
 
+    # async hot loop: losses stay on device — float() every step would
+    # stall dispatch; one block_until_ready fence resolves the whole run
     losses = []
     for _ in range(40):
         tree, opt, loss = step(tree, opt, tokens, labels)
-        losses.append(float(loss))
+        losses.append(loss)
+    losses = [float(l) for l in jax.block_until_ready(losses)]
     print(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
     print(f"loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
     assert losses[-1] < losses[0], "fine-tune loss should drop"

@@ -1,0 +1,287 @@
+"""Async trainer hot loop: lazy losses, bucketed padding, pipelined input.
+
+Pins the PR's acceptance criteria:
+- dp-parity: async + bucketed ``fit`` produces the same losses as the
+  synchronous per-step path on the same data/seed,
+- exactness: the padding mask keeps the loss average exact (a padded
+  step's loss equals the loss_fn evaluated on just the real rows),
+- bounded compilation: a ragged fit compiles once per BUCKET, not once
+  per distinct shape (``train_step.recompile``),
+- fences: checkpoints resolve the pending-loss ring first; the ring
+  self-fences at ``max_pending``,
+- streaming: ``fit`` consumes one-shot generators without ``list(data)``,
+- ``prefetch_to_device``: empty/size-1/sharded/threaded lifecycles.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel import DataParallelTrainer, LazyLoss
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+from deeplearning4j_tpu.parallel.mesh import DP, local_mesh
+
+
+def _toy(seed=0, d=6):
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=(d, 1))
+
+    def loss_fn(p, x, y, key=None):
+        return ((x @ p["w"] - y) ** 2).mean()
+
+    params = {"w": np.zeros((d, 1), np.float32)}
+    return params, loss_fn, w, rng
+
+
+def _ragged_batches(rng, w, sizes, d=6):
+    out = []
+    for n in sizes:
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        out.append(DataSet(x, (x @ w).astype(np.float32)))
+    return out
+
+
+RAGGED = [32, 17, 9, 23, 32, 5, 29, 13]
+
+
+# --------------------------------------------------------------- parity
+def test_async_fit_matches_sync_fit_on_ragged_batches():
+    """Acceptance: async + bucketed == synchronous per-step, same data/seed."""
+    params, loss_fn, w, rng = _toy()
+    data = _ragged_batches(rng, w, RAGGED)
+
+    def make():
+        return DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
+                                                    T.sgd_lr(0.01)))
+
+    t_async = make()
+    s_a, l_async = t_async.fit(t_async.init_state(params), data, epochs=2,
+                               async_dispatch=True, resolve_every=3)
+    t_sync = make()
+    s_s, l_sync = t_sync.fit(t_sync.init_state(params), data, epochs=2,
+                             async_dispatch=False)
+    assert len(l_async) == len(l_sync) == 2 * len(RAGGED)
+    np.testing.assert_allclose(l_async, l_sync, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(s_a.params), jax.tree.leaves(s_s.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_padded_step_loss_is_exact():
+    """The validity mask makes the padded bucket's loss equal the loss of
+    the REAL rows alone — padding must not dilute the average."""
+    params, loss_fn, w, rng = _toy()
+    x = rng.normal(size=(13, 6)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.0))  # lr 0: params fixed
+    state = trainer.init_state(params)
+    direct = float(loss_fn({"w": jnp.zeros((6, 1))}, x, y))
+    _, lazy = trainer.step(state, x, y)  # 13 -> bucket 16, 3 padded rows
+    assert float(lazy) == pytest.approx(direct, abs=1e-5)
+
+
+# --------------------------------------------------------------- buckets
+def test_ragged_fit_compiles_once_per_bucket():
+    """Acceptance: #compilations == #buckets, not #distinct shapes."""
+    params, loss_fn, w, rng = _toy()
+    data = _ragged_batches(rng, w, RAGGED)
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    trainer.fit(trainer.init_state(params), data, epochs=2)
+    counters = METRICS.snapshot()["counters"]
+    # sizes 32,29,23,17 -> 32; 13,9 -> 16; 5 -> 8: three buckets
+    assert counters["train_step.recompile"] == 3
+    assert len(trainer._step_cache) == 3
+    assert counters["train_step.iterations"] == 2 * len(RAGGED)
+
+
+def test_oversized_batch_gets_own_bucket():
+    params, loss_fn, w, rng = _toy()
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    state = trainer.init_state(params)
+    state, _ = trainer.step(state, *_xy(rng, w, 16))   # nominal 16
+    state, _ = trainer.step(state, *_xy(rng, w, 40))   # > nominal: 40
+    state, _ = trainer.step(state, *_xy(rng, w, 7))    # pow2 -> 8
+    assert sorted(trainer._step_cache) == [8, 16, 40]
+
+
+def _xy(rng, w, n, d=6):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+# --------------------------------------------------------------- lazy ring
+def test_lazy_loss_handle():
+    params, loss_fn, w, rng = _toy()
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    state = trainer.init_state(params)
+    _, lazy = trainer.step(state, *_xy(rng, w, 16))
+    assert isinstance(lazy, LazyLoss)
+    assert not lazy.resolved and "pending" in repr(lazy)
+    v = float(lazy)
+    assert np.isfinite(v) and lazy.resolved
+    assert f"{lazy:.3f}" == f"{v:.3f}"
+    assert lazy.value() == v  # idempotent after resolution
+
+
+def test_pending_ring_self_fences_at_max_pending():
+    params, loss_fn, w, rng = _toy()
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05), max_pending=4)
+    state = trainer.init_state(params)
+    for _ in range(10):
+        state, _ = trainer.step(state, *_xy(rng, w, 16))
+    # 10 = 4 + 4 + 2: two auto-resolves fired, two entries still pending
+    assert len(trainer._pending) == 2
+    assert METRICS.snapshot()["counters"]["train_step.losses_resolved"] == 8
+
+
+def test_resolution_point_owns_the_gauges():
+    """Loss/throughput gauges appear at resolution, not at dispatch."""
+    params, loss_fn, w, rng = _toy()
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    state = trainer.init_state(params)
+    losses = []
+    for _ in range(3):
+        state, lazy = trainer.step(state, *_xy(rng, w, 16))
+        losses.append(lazy)
+    assert "train_step.loss" not in METRICS.snapshot()["gauges"]
+    vals = trainer._resolve_pending()
+    snap = METRICS.snapshot()
+    assert snap["gauges"]["train_step.loss"] == pytest.approx(vals[-1])
+    assert snap["gauges"]["train_step.samples_per_sec"] > 0
+    assert snap["timers"]["train_step.execute"]["count"] == 3
+    assert [float(l) for l in losses] == vals
+
+
+# --------------------------------------------------------------- fences
+def test_checkpoint_fences_pending_ring(tmp_path):
+    params, loss_fn, w, rng = _toy()
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    state = trainer.init_state(params)
+    for _ in range(3):
+        state, _ = trainer.step(state, *_xy(rng, w, 16))
+    assert trainer._pending  # ring is hot
+    mgr = CheckpointManager(tmp_path)
+    trainer.checkpoint(state, mgr)
+    assert not trainer._pending  # fenced before the save read params
+    assert METRICS.snapshot()["counters"]["checkpoint.fences"] == 1
+    assert mgr.latest_step() == state.step
+
+
+# --------------------------------------------------------------- streaming
+def test_fit_streams_one_shot_generator():
+    """fit must not call list(data): a one-shot generator of (x, y) tuples
+    with no __len__ streams through, and every loss comes back resolved."""
+    params, loss_fn, w, rng = _toy()
+
+    def gen():
+        for n in (16, 9, 16, 5):
+            yield _xy(rng, w, n)
+
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    state, losses = trainer.fit(trainer.init_state(params), gen())
+    assert state.step == 4 and len(losses) == 4
+    assert all(isinstance(l, float) and np.isfinite(l) for l in losses)
+    assert not trainer._pending  # fit's final resolve drained the ring
+
+
+def test_fit_without_prefetch_matches_prefetched():
+    params, loss_fn, w, rng = _toy()
+    data = _ragged_batches(rng, w, [16, 9, 12, 16])
+    t1 = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    _, l1 = t1.fit(t1.init_state(params), data, prefetch_size=2)
+    t2 = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    _, l2 = t2.fit(t2.init_state(params), data, prefetch_size=0)
+    np.testing.assert_allclose(l1, l2, atol=1e-6)
+
+
+def test_hogwild_ragged_fit_smoke():
+    params, loss_fn, w, rng = _toy()
+    data = _ragged_batches(rng, w, [32, 17, 32, 9])
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05), router="hogwild",
+                                  average_every=2)
+    state, losses = trainer.fit(trainer.init_state(params), data, epochs=2)
+    assert len(losses) == 8 and all(np.isfinite(l) for l in losses)
+    final = trainer.final_params(state)
+    assert all(np.isfinite(np.asarray(a)).all()
+               for a in jax.tree.leaves(final))
+
+
+# --------------------------------------------------------- prefetch_to_device
+def test_prefetch_empty_iterable():
+    assert list(prefetch_to_device([])) == []
+    assert list(prefetch_to_device(iter([]), size=1)) == []
+
+
+def test_prefetch_buffer_size_one_preserves_order():
+    batches = [(np.full((4, 2), i), np.full((4, 1), -i)) for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=1))
+    assert len(out) == 5
+    for i, (a, b) in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(a), batches[i][0])
+        np.testing.assert_array_equal(np.asarray(b), batches[i][1])
+
+
+def test_prefetch_explicit_sharding_places_leaves():
+    mesh = local_mesh()
+    sh = NamedSharding(mesh, P(DP))
+    batches = [(np.zeros((16, 4), np.float32), np.zeros((16, 1), np.float32))]
+    (a, b), = prefetch_to_device(batches, sharding=sh)
+    assert a.sharding == sh and b.sharding == sh
+    # non-array leaves (the trainer's python-int sample counts) pass through
+    (x, n), = prefetch_to_device([(np.zeros((16, 2), np.float32), 13)],
+                                 sharding=sh)
+    assert isinstance(n, int) and n == 13
+
+
+def test_prefetch_host_thread_exits_on_exhaustion():
+    batches = ((np.full((4, 2), i), np.full((4, 1), i)) for i in range(6))
+    pf = prefetch_to_device(batches, size=2, host_thread=True)
+    out = list(pf)
+    assert len(out) == 6
+    pf.thread.join(timeout=5.0)
+    assert not pf.thread.is_alive()  # no leaked worker after exhaustion
+
+
+def test_prefetch_host_thread_close_mid_stream():
+    """Abandoning iteration with a full queue must not leak the worker."""
+
+    def gen():
+        for i in range(1000):
+            yield (np.full((4, 2), i),)
+
+    pf = prefetch_to_device(gen(), size=2, host_thread=True)
+    first = next(pf)
+    np.testing.assert_array_equal(np.asarray(first[0]), np.full((4, 2), 0))
+    pf.close()
+    assert not pf.thread.is_alive()
+    pf.close()  # idempotent
+
+
+def test_prefetch_host_thread_propagates_source_error():
+    def gen():
+        yield (np.zeros((4, 2)),)
+        raise RuntimeError("boom in the input pipeline")
+
+    pf = prefetch_to_device(gen(), size=2, host_thread=True)
+    # the worker may surface the error before or after handing over the
+    # staged batch — either way it must raise, and must not leak the thread
+    with pytest.raises(RuntimeError, match="boom"):
+        for _ in pf:
+            pass
+    pf.close()
+    assert not pf.thread.is_alive()
+
+
+# --------------------------------------------------------------- registry
+def test_observe_many_batches_under_one_histogram():
+    METRICS.observe_many("t.batch", [0.1, 0.2, 0.3])
+    s = METRICS.snapshot()["timers"]["t.batch"]
+    assert s["count"] == 3
+    METRICS.observe_many("t.batch", [])
+    assert METRICS.snapshot()["timers"]["t.batch"]["count"] == 3

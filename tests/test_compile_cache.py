@@ -1,0 +1,59 @@
+"""Persistent-compilation-cache wiring: opt-in, idempotent, env-gated."""
+
+import jax
+import pytest
+
+from deeplearning4j_tpu.parallel import compile_cache as cc
+
+
+@pytest.fixture
+def _restore_cache_config(monkeypatch):
+    """Snapshot jax's cache config and the module's process-global state so
+    these tests cannot leak a cache dir into the rest of the suite."""
+    saved = {n: getattr(jax.config, n) for n in (
+        "jax_enable_compilation_cache", "jax_compilation_cache_dir",
+        "jax_persistent_cache_min_compile_time_secs",
+        "jax_persistent_cache_min_entry_size_bytes")}
+    monkeypatch.delenv(cc.ENV_DIR, raising=False)
+    monkeypatch.delenv(cc.ENV_ENABLE, raising=False)
+    cc._reset_for_tests()
+    yield
+    for n, v in saved.items():
+        jax.config.update(n, v)
+    cc._reset_for_tests()
+
+
+def test_unset_is_noop(_restore_cache_config):
+    assert cc.setup_compile_cache() is None
+    assert cc.configured_dir() is None
+
+
+def test_explicit_dir_configures_jax(tmp_path, _restore_cache_config):
+    d = str(tmp_path / "xla")
+    assert cc.setup_compile_cache(d) == d
+    assert jax.config.jax_compilation_cache_dir == d
+    assert jax.config.jax_enable_compilation_cache is True
+    assert cc.configured_dir() == d
+
+
+def test_first_dir_wins(tmp_path, _restore_cache_config):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    assert cc.setup_compile_cache(d1) == d1
+    # later callers (trainer/multilayer constructors) get the configured
+    # dir back — repointing a process-global cache would only split it
+    assert cc.setup_compile_cache(d2) == d1
+    assert jax.config.jax_compilation_cache_dir == d1
+
+
+def test_env_dir_used_when_no_arg(tmp_path, monkeypatch,
+                                  _restore_cache_config):
+    d = str(tmp_path / "env-xla")
+    monkeypatch.setenv(cc.ENV_DIR, d)
+    assert cc.setup_compile_cache() == d
+
+
+def test_kill_switch(tmp_path, monkeypatch, _restore_cache_config):
+    monkeypatch.setenv(cc.ENV_ENABLE, "0")
+    monkeypatch.setenv(cc.ENV_DIR, str(tmp_path / "xla"))
+    assert cc.setup_compile_cache(str(tmp_path / "explicit")) is None
+    assert cc.configured_dir() is None

@@ -1,0 +1,40 @@
+"""Tier-1 wiring for ``tools/perf_smoke.py`` — the bounded-recompile guard.
+
+Fast by design (30 tiny CPU steps, a handful of bucket compiles): NOT
+marked slow, so the bucketing regression is caught on every tier-1 run.
+"""
+
+import importlib.util
+import pathlib
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_perf_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "perf_smoke", _TOOLS / "perf_smoke.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_smoke_bounded_recompiles():
+    ps = _load_perf_smoke()
+    result = ps.run(steps=30)
+    # run() asserts the invariants internally; pin the headline ones here
+    # too so a refactor of run() cannot silently drop them
+    assert result["steps"] == 30
+    assert result["recompiles"] == result["expected_buckets"]
+    # ragged sizes collapse onto a small ladder: strictly fewer compiles
+    # than distinct raw batch sizes (the whole point of bucketing)
+    assert result["recompiles"] < len(set(ps.RAGGED_SIZES))
+    assert result["losses_finite"]
+
+
+def test_expected_buckets_ladder():
+    ps = _load_perf_smoke()
+    # nominal 32 (first size), dp 8: pow2 ladder rounded to 8s, capped
+    assert ps.expected_buckets([32, 31, 17, 9, 23, 13, 32, 5, 29, 11], 8) \
+        == {8, 16, 32}
+    # oversized batches round to the dp width, uncapped
+    assert ps.expected_buckets([16, 40], 8) == {16, 40}

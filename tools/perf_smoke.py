@@ -1,0 +1,111 @@
+"""Perf smoke: bounded-recompile guard for the async trainer hot loop.
+
+Runs a 30-step CPU fit whose batch sizes are deliberately ragged and
+asserts the steady-state number of XLA compilations equals the number of
+padding *buckets* actually used (`train_step.recompile` counter) — the
+regression this guards against is the pre-bucketing behavior where every
+distinct ragged shape silently compiled a fresh step program.
+
+The expected bucket set is an INDEPENDENT reimplementation of the
+trainer's ladder (powers of two rounded up to the dp width, capped at the
+nominal batch): if someone changes the trainer's bucketing they must
+consciously change this file too, not just watch a counter follow along.
+
+Wired as a fast tier-1 test (`tests/test_perf_smoke.py`); also runnable
+standalone: `python tools/perf_smoke.py` prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+# the ragged pattern: first size fixes the nominal bucket cap
+RAGGED_SIZES = [32, 31, 17, 9, 23, 13, 32, 5, 29, 11]
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def expected_buckets(sizes, n_dp: int) -> set[int]:
+    """Reference bucket ladder (kept independent of the trainer's code)."""
+    nominal = _round_up(sizes[0], n_dp)
+    out = set()
+    for n in sizes:
+        if n >= nominal:
+            out.add(_round_up(n, n_dp))
+        else:
+            out.add(min(_round_up(1 << math.ceil(math.log2(n)), n_dp), nominal))
+    return out
+
+
+def run(steps: int = 30) -> dict:
+    import numpy as np
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    observability.enable()
+    METRICS.reset()
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(6, 1))
+
+    def batches():
+        for k in range(steps):
+            n = RAGGED_SIZES[k % len(RAGGED_SIZES)]
+            x = rng.normal(size=(n, 6)).astype(np.float32)
+            y = (x @ w_true).astype(np.float32)
+            yield DataSet(x, y)
+
+    def loss_fn(p, x, y, key=None):
+        return ((x @ p["w"] - y) ** 2).mean()
+
+    trainer = DataParallelTrainer(loss_fn, T.sgd_lr(0.05))
+    params = {"w": np.zeros((6, 1), np.float32)}
+    state, losses = trainer.fit(trainer.init_state(params), batches())
+
+    snap = METRICS.snapshot()["counters"]
+    recompiles = int(snap.get("train_step.recompile", 0))
+    n_buckets = len(expected_buckets(
+        [RAGGED_SIZES[k % len(RAGGED_SIZES)] for k in range(steps)],
+        trainer.n_dp))
+    result = {
+        "steps": int(snap.get("train_step.iterations", 0)),
+        "recompiles": recompiles,
+        "expected_buckets": n_buckets,
+        "n_dp": trainer.n_dp,
+        "losses_finite": all(math.isfinite(l) for l in losses),
+        "final_loss": losses[-1] if losses else None,
+    }
+    assert result["steps"] == steps, f"ran {result['steps']}/{steps} steps"
+    assert result["losses_finite"], "non-finite loss in smoke run"
+    assert recompiles == n_buckets, (
+        f"{recompiles} recompiles != {n_buckets} buckets — "
+        "per-shape recompilation is back (or the ladder changed; "
+        "update expected_buckets deliberately)")
+    return result
+
+
+def main() -> int:
+    print(json.dumps(run()))
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+    import pathlib
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())
