@@ -133,6 +133,15 @@ def _discover_devices(attempts: int = None, timeout_s: float = None,
     return jax.devices("cpu"), reason, failures
 
 
+def _host_float(x) -> float:
+    """Pull one device scalar to host EXPLICITLY (jax.device_get), so the
+    bench's per-iteration trust-guard sync stays legal under the hot-loop
+    ``jax.transfer_guard("disallow")`` scopes."""
+    import jax
+
+    return float(np.asarray(jax.device_get(x)))
+
+
 def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
                 prefetch=False, async_losses=False, metric=None):
     """Run ``iters`` steps rotating batches, syncing to host EVERY
@@ -166,6 +175,7 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
     """
     import jax
 
+    from deeplearning4j_tpu.analysis.runtime import hot_loop_guard
     from deeplearning4j_tpu.observability import METRICS
 
     def record(dt):
@@ -176,36 +186,43 @@ def _timed_loop(step, params, opt, batches, iters, stage_on_device=False,
     if stage_on_device:
         batches = [tuple(map(jax.device_put, b)) for b in batches]
     iter_times, loss = [], None
+    # every timed leg runs under the transfer guard: batch staging is an
+    # explicit device_put and the trust-guard sync an explicit device_get,
+    # so anything ELSE crossing the PCIe/ICI link mid-loop raises instead
+    # of silently polluting the measurement
     if prefetch:
         from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
         feed = prefetch_to_device(
             (batches[k % len(batches)] for k in range(iters)), size=2)
-        for a, b in feed:
-            t0 = time.perf_counter()
-            params, opt, loss = step(params, opt, a, b)
-            loss = float(np.asarray(loss))       # forced host sync
-            record(time.perf_counter() - t0)
+        with hot_loop_guard():
+            for a, b in feed:
+                t0 = time.perf_counter()
+                params, opt, loss = step(params, opt, a, b)
+                loss = _host_float(loss)         # forced host sync
+                record(time.perf_counter() - t0)
         return iter_times, loss, params, opt
     if async_losses:
         pending = []
+        with hot_loop_guard():
+            for k in range(iters):
+                a, b = batches[k % len(batches)]
+                t0 = time.perf_counter()
+                if not stage_on_device:
+                    a, b = jax.device_put(a), jax.device_put(b)
+                params, opt, loss = step(params, opt, a, b)
+                pending.append(loss)             # stays on device
+                record(time.perf_counter() - t0)  # dispatch time only
+            jax.block_until_ready(pending)       # the single end fence
+        return iter_times, _host_float(pending[-1]), params, opt
+    with hot_loop_guard():
         for k in range(iters):
             a, b = batches[k % len(batches)]
             t0 = time.perf_counter()
             if not stage_on_device:
                 a, b = jax.device_put(a), jax.device_put(b)
             params, opt, loss = step(params, opt, a, b)
-            pending.append(loss)                 # stays on device
-            record(time.perf_counter() - t0)     # dispatch time only
-        jax.block_until_ready(pending)           # the single end fence
-        return iter_times, float(np.asarray(pending[-1])), params, opt
-    for k in range(iters):
-        a, b = batches[k % len(batches)]
-        t0 = time.perf_counter()
-        if not stage_on_device:
-            a, b = jax.device_put(a), jax.device_put(b)
-        params, opt, loss = step(params, opt, a, b)
-        loss = float(np.asarray(loss))           # forced host sync
-        record(time.perf_counter() - t0)
+            loss = _host_float(loss)             # forced host sync
+            record(time.perf_counter() - t0)
     return iter_times, loss, params, opt
 
 
@@ -351,7 +368,7 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         # compile + warmup (excluded from timing)
         a, b = map(jax.device_put, batches[0])
         params, opt, loss = step(params, opt, a, b)
-        warm_loss = float(np.asarray(loss))
+        warm_loss = _host_float(loss)
 
         # XLA's own FLOPs estimate for one step (independent cross-check)
         xla_flops = None
@@ -462,7 +479,7 @@ def _resnet_leg(dev, on_tpu, batch_override=None):
         jstep = jax.jit(step, donate_argnums=(0, 1))
         a, b = map(jax.device_put, batches[0])
         params, opt, loss = jstep(params, opt, a, b)
-        float(np.asarray(loss))
+        _host_float(loss)
         iter_times, last_loss, params, opt = _timed_loop(
             jstep, params, opt, batches, iters, stage_on_device=True,
             metric="bench.resnet.step")
@@ -555,17 +572,20 @@ def _word2vec_leg(dev, on_tpu):
         return out
 
     def timed(step_fn, make_args, state):
+        from deeplearning4j_tpu.analysis.runtime import hot_loop_guard
+
         ts = []
         pool = batches(4)
         args = make_args(pool[0])
         state = step_fn(*state, *args)                 # compile + warmup
-        float(np.asarray(state[0][0, 0]))
-        for k in range(iters):
-            args = make_args(pool[k % len(pool)])
-            t0 = time.perf_counter()
-            state = step_fn(*state, *args)
-            float(np.asarray(state[0][0, 0]))          # forced host sync
-            ts.append(time.perf_counter() - t0)
+        _host_float(state[0][0, 0])
+        with hot_loop_guard():
+            for k in range(iters):
+                args = make_args(pool[k % len(pool)])
+                t0 = time.perf_counter()
+                state = step_fn(*state, *args)
+                _host_float(state[0][0, 0])            # forced host sync
+                ts.append(time.perf_counter() - t0)
         return ts
 
     with jax.default_device(dev):

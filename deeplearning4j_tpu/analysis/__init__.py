@@ -1,0 +1,32 @@
+"""graftlint — static JAX/TPU hazard analysis + runtime transfer guards.
+
+Two halves of one contract (DESIGN.md §11):
+
+- **static**: an AST rule engine (``engine.Analyzer``) with six rules for
+  the hazards PR 2 removed by hand — host syncs in hot paths (HS01),
+  recompile storms (RC01), PRNG key reuse (RNG01), use-after-donate
+  (DON01), traced-value branching (TB01), and uninstrumented hot loops
+  (HOT02) — plus per-line suppressions and a committed baseline so
+  ``python -m tools.graftlint --check`` can gate every PR on *new*
+  violations only.
+- **runtime**: ``runtime.hot_loop_guard()`` wraps the trainer/bench hot
+  loops in ``jax.transfer_guard("disallow")`` so implicit transfers fail
+  loudly at the call site (opt out: ``DL4J_TPU_TRANSFER_GUARD=0``).
+
+Results flow through the PR 1 observability layer as
+``graftlint.violations.<RULE>`` gauges (``report.emit_metrics``).
+"""
+
+from .baseline import Baseline
+from .core import ACTIVE, BASELINED, SUPPRESSED, Finding, Rule, all_rules
+from .engine import Analyzer, active
+from .jitinfo import JitInfo, ModuleInfo
+from .report import emit_metrics, summarize, to_json, to_text
+from .runtime import ENV_FLAG, allow_transfers, guard_mode, hot_loop_guard
+
+__all__ = [
+    "ACTIVE", "Analyzer", "BASELINED", "Baseline", "ENV_FLAG", "Finding",
+    "JitInfo", "ModuleInfo", "Rule", "SUPPRESSED", "active", "all_rules",
+    "allow_transfers", "emit_metrics", "guard_mode", "hot_loop_guard",
+    "summarize", "to_json", "to_text",
+]

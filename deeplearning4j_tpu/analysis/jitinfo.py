@@ -1,0 +1,244 @@
+"""Per-module JAX context: which callables are jit-compiled, which function
+bodies are traced, what they donate, and what is static.
+
+Everything here is a single-module, best-effort static approximation — the
+registry resolves the idioms this codebase actually uses:
+
+- ``@jax.jit`` / ``@partial(jax.jit, donate_argnums=...)`` decorations
+- ``fn = jax.jit(step, donate_argnums=(0, 1))`` assignments
+- ``return jax.jit(sm, ...)`` inside a builder function ("jit factory"),
+  plus ``self._hs_fn = build_hs_step(...)`` assignments from a factory
+- functions handed to ``shard_map``/``pmap`` (traced, even if the jit
+  wrapper lives elsewhere)
+
+Cross-module flow (a factory imported from another file) is out of scope:
+rules that need it match on the callee's *basename* instead, which is why
+suppressions exist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .core import (
+    assigned_names,
+    dotted_name,
+    iter_functions,
+    last_segment,
+    literal_int_tuple,
+)
+
+#: canonical callables that compile/trace their function argument
+_JIT_WRAPPERS = {"jax.jit", "jit"}
+_TRACE_WRAPPERS = {"shard_map", "pmap", "vmap_of_jit"}  # by basename
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """What we know about one jit-compiled callable."""
+
+    name: str                                  # dotted name it is bound to
+    donate_argnums: tuple[int, ...] = ()
+    static_argnums: tuple[int, ...] = ()
+    line: int = 0
+
+
+class ModuleInfo:
+    """Parsed module + the JAX facts the rules consume."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = self._collect_aliases()
+        #: dotted name -> JitInfo for every callable known to be jitted
+        self.jitted: dict[str, JitInfo] = {}
+        #: basenames of jitted callables (attribute-call matching)
+        self.jitted_basenames: set[str] = set()
+        #: function defs whose BODY is traced (jit/shard_map/pmap), with the
+        #: wrapper's JitInfo when known
+        self.traced_defs: dict[ast.FunctionDef, JitInfo | None] = {}
+        #: local def basenames whose body calls a jitted callable (one level
+        #: of propagation for hot-loop rules)
+        self.dispatching_basenames: set[str] = set()
+        self._factories: dict[str, JitInfo] = {}
+        self._collect_jit_facts()
+
+    # ------------------------------------------------------------------ text
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # ------------------------------------------------------------------ names
+    def _collect_aliases(self) -> dict[str, str]:
+        """local name -> canonical dotted prefix (``jnp`` -> ``jax.numpy``,
+        ``partial`` -> ``functools.partial``)."""
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def canonical(self, node: ast.AST) -> str | None:
+        """Dotted name with the leading segment alias-resolved:
+        ``jnp.arange`` -> ``jax.numpy.arange``."""
+        name = dotted_name(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    # ------------------------------------------------------------------ jit facts
+    def _wrapper_info(self, call: ast.Call) -> tuple[ast.AST | None, JitInfo] | None:
+        """If ``call`` is a jit/trace wrapper invocation, return
+        (wrapped_fn_expr_or_None, JitInfo-from-kwargs)."""
+        canon = self.canonical(call.func)
+        if canon is None:
+            # partial(jax.jit, ...) used as a decorator factory
+            return None
+        base = last_segment(canon)
+        is_jit = canon in _JIT_WRAPPERS or canon.endswith(".jit")
+        is_trace = base in ("shard_map", "pmap") or canon.endswith(".pmap")
+        if canon in ("functools.partial", "partial") and call.args:
+            inner = self.canonical(call.args[0])
+            if inner and (inner in _JIT_WRAPPERS or inner.endswith(".jit")):
+                info = self._info_from_kwargs(call, name="")
+                wrapped = call.args[1] if len(call.args) > 1 else None
+                return wrapped, info
+            return None
+        if not (is_jit or is_trace):
+            return None
+        info = self._info_from_kwargs(call, name="")
+        wrapped = call.args[0] if call.args else None
+        return wrapped, info
+
+    @staticmethod
+    def _info_from_kwargs(call: ast.Call, name: str) -> JitInfo:
+        donate = static = None
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                donate = literal_int_tuple(kw.value)
+            elif kw.arg in ("static_argnums", "static_argnames"):
+                static = literal_int_tuple(kw.value)
+        return JitInfo(name=name, donate_argnums=donate or (),
+                       static_argnums=static or (),
+                       line=getattr(call, "lineno", 0))
+
+    def _register_jitted(self, name: str, info: JitInfo) -> None:
+        info = dataclasses.replace(info, name=name)
+        self.jitted[name] = info
+        self.jitted_basenames.add(last_segment(name))
+
+    def _collect_jit_facts(self) -> None:
+        defs_by_name = {fn.name: fn for fn in iter_functions(self.tree)}
+
+        # pass 1: decorated defs + every wrapper call in the module
+        for fn in iter_functions(self.tree):
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    hit = self._wrapper_info(dec)
+                    if hit is not None:
+                        _, info = hit
+                        self.traced_defs[fn] = info
+                        self._register_jitted(fn.name, info)
+                else:
+                    canon = self.canonical(dec)
+                    if canon and (canon in _JIT_WRAPPERS
+                                  or canon.endswith(".jit")):
+                        info = JitInfo(name=fn.name, line=fn.lineno)
+                        self.traced_defs[fn] = info
+                        self._register_jitted(fn.name, info)
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = self._wrapper_info(node)
+            if hit is None:
+                continue
+            wrapped, info = hit
+            wname = dotted_name(wrapped) if wrapped is not None else None
+            if wname and last_segment(wname) in defs_by_name:
+                fd = defs_by_name[last_segment(wname)]
+                prior = self.traced_defs.get(fd)
+                # a shard_map'd fn later jitted keeps the jit's donate info
+                if prior is None or (not prior.donate_argnums
+                                     and info.donate_argnums):
+                    self.traced_defs[fd] = dataclasses.replace(
+                        prior or info,
+                        donate_argnums=(info.donate_argnums
+                                        or (prior.donate_argnums
+                                            if prior else ())),
+                        static_argnums=(info.static_argnums
+                                        or (prior.static_argnums
+                                            if prior else ())))
+
+        # pass 2: assignments + jit factories (statement order matters for
+        # neither: two sub-passes over the whole tree)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                hit = self._wrapper_info(node.value)
+                if hit is not None:
+                    _, info = hit
+                    for t in node.targets:
+                        for name in assigned_names(t):
+                            self._register_jitted(name, info)
+
+        for fn in iter_functions(self.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Return) and isinstance(
+                        node.value, ast.Call):
+                    hit = self._wrapper_info(node.value)
+                    if hit is not None:
+                        _, info = hit
+                        self._factories[fn.name] = info
+
+        # pass 3: `self._fn = build_step(...)` from a local jit factory
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                callee = dotted_name(node.value.func)
+                if callee and last_segment(callee) in self._factories:
+                    info = self._factories[last_segment(callee)]
+                    for t in node.targets:
+                        for name in assigned_names(t):
+                            self._register_jitted(name, info)
+
+        # pass 4: defs that CALL a jitted callable (device-dispatch
+        # propagation for hot-loop rules)
+        for fn in iter_functions(self.tree):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    callee = dotted_name(node.func)
+                    if callee and self.is_jitted_call(callee):
+                        self.dispatching_basenames.add(fn.name)
+                        break
+
+    # ------------------------------------------------------------------ queries
+    def is_jitted_call(self, callee: str) -> bool:
+        """Does a call to dotted name ``callee`` hit a known-jitted
+        callable?  Exact dotted match, else basename match (covers
+        ``self._hs_fn`` style attribute calls)."""
+        return (callee in self.jitted
+                or last_segment(callee) in self.jitted_basenames)
+
+    def jit_info_for_call(self, callee: str) -> JitInfo | None:
+        if callee in self.jitted:
+            return self.jitted[callee]
+        base = last_segment(callee)
+        for name, info in self.jitted.items():
+            if last_segment(name) == base:
+                return info
+        return None
+
+    def is_dispatching_call(self, callee: str) -> bool:
+        """Jitted call, or a call to a local def that itself dispatches."""
+        return (self.is_jitted_call(callee)
+                or last_segment(callee) in self.dispatching_basenames)

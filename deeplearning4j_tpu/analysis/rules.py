@@ -1,0 +1,564 @@
+"""The graftlint rule set — six hazard classes from this repo's history.
+
+| rule  | hazard                                                           |
+|-------|------------------------------------------------------------------|
+| HS01  | host sync (`float`/`.item()`/`np.asarray`/`device_get`) on a     |
+|       | jit-produced value in a hot path                                 |
+| RC01  | recompile hazard: Python-value-dependent shapes inside a traced  |
+|       | function; non-hashable literals in static arg positions          |
+| RNG01 | PRNG key reuse: same key fed to two `jax.random.*` calls without |
+|       | a `split`/reassignment between them                              |
+| DON01 | use-after-donate: an argument at a `donate_argnums` position     |
+|       | read again after the jitted call                                 |
+| TB01  | Python `if`/`while` branching on a traced value inside a jitted  |
+|       | function                                                         |
+| HOT02 | loop dispatching device work with no `trace.span`/`METRICS`      |
+|       | instrumentation anywhere in reach (bypasses the PR 1 layer)      |
+
+Each rule documents its known blind spots; deliberate hits are silenced
+inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
+the committed baseline with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .core import (
+    Finding,
+    Rule,
+    assigned_names,
+    body_statements,
+    dotted_name,
+    last_segment,
+    names_read,
+    register,
+    statement_targets,
+)
+from .jitinfo import ModuleInfo
+
+#: callables whose canonical name forces a device->host read of their arg
+_SYNC_CALLS = {
+    "float", "int", "bool",
+    "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+#: method names that force a device->host read of their receiver
+_SYNC_METHODS = {"item", "tolist"}
+
+#: jnp constructors whose first argument fixes an output shape
+_SHAPE_CONSTRUCTORS = {
+    "jax.numpy.arange", "jax.numpy.zeros", "jax.numpy.ones",
+    "jax.numpy.full", "jax.numpy.empty", "jax.numpy.eye",
+    "jax.numpy.linspace", "jax.numpy.tri",
+}
+
+#: attributes of a traced array that are static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+
+#: observability markers — any of these in reach means the loop reports
+#: through the PR 1 layer
+_OBS_MARKERS = ("span", "observe_time", "observe_many", "increment",
+                "gauge", "time", "iteration_done")
+_OBS_BASES = ("trace", "METRICS", "TRACER", "registry", "self.registry")
+
+
+def _function_loops(fn: ast.FunctionDef) -> list[ast.stmt]:
+    """Top-to-bottom list of loop statements in ``fn`` (not nested defs)."""
+    loops = []
+    for stmt in body_statements(fn.body):
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            loops.append(stmt)
+    return loops
+
+
+def _calls_in(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def _is_sync_call(module: ModuleInfo, call: ast.Call) -> ast.AST | None:
+    """The expression being synced to host, or None."""
+    canon = module.canonical(call.func)
+    if canon in _SYNC_CALLS and call.args:
+        return call.args[0]
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _SYNC_METHODS and not call.args):
+        return call.func.value
+    return None
+
+
+@register
+class HostSyncRule(Rule):
+    """HS01 — device->host sync of a jit-produced value in a hot path.
+
+    Taint: names bound from a call to a known-jitted callable inside the
+    same function.  A sync call (``float``/``int``/``.item()``/
+    ``np.asarray``/``jax.device_get``) whose argument reads a tainted name
+    fires when it happens (a) inside a loop, or (b) anywhere in a
+    loop-free function — the ``_apply_step``-style per-call method whose
+    *caller* is the loop.  Syncs after a loop in a loop-containing
+    function are treated as deliberate fences and left alone.
+    """
+
+    id = "HS01"
+    title = "host sync on jit-produced value in hot path"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, node)
+
+    def _check_function(self, module: ModuleInfo,
+                        fn: ast.FunctionDef) -> Iterator[Finding]:
+        tainted: set[str] = set()
+        for stmt in body_statements(fn.body):
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, (ast.Call, ast.Tuple)):
+                for call in _calls_in(stmt.value):
+                    callee = dotted_name(call.func)
+                    if callee and module.is_jitted_call(callee):
+                        for t in stmt.targets:
+                            tainted.update(assigned_names(t))
+                        break
+        if not tainted:
+            return
+        has_loop = bool(_function_loops(fn))
+        loop_nodes = set()
+        for loop in _function_loops(fn):
+            for n in ast.walk(loop):
+                loop_nodes.add(id(n))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            arg = _is_sync_call(module, node)
+            if arg is None:
+                continue
+            hit = tainted & names_read(arg)
+            # direct form: float(self._step_fn(...))
+            if not hit:
+                inner = [c for c in _calls_in(arg)
+                         if (dotted_name(c.func)
+                             and module.is_jitted_call(dotted_name(c.func)))]
+                if inner:
+                    hit = {dotted_name(inner[0].func)}
+            if not hit:
+                continue
+            in_loop = id(node) in loop_nodes
+            if in_loop or not has_loop:
+                where = ("inside a loop" if in_loop
+                         else "in a loop-free per-call function")
+                yield self.finding(
+                    module, node,
+                    f"host sync of jit-produced value {sorted(hit)[0]!r} "
+                    f"{where}: forces the async dispatch queue to drain "
+                    "every call — return the device value and resolve at "
+                    "the caller's fence (LazyLoss pattern, DESIGN.md §10)")
+
+
+@register
+class RecompileRule(Rule):
+    """RC01 — shapes that depend on Python values inside traced code.
+
+    Fires on ``jnp.arange(n)``-style constructors whose size argument
+    reads a *parameter* of the traced function (``x.shape[0]`` is fine —
+    static under bucketing), and on list/dict/set literals passed at a
+    known ``static_argnums`` position (unhashable -> TypeError at call
+    time; hashable-but-fresh objects recompile every call).
+    """
+
+    id = "RC01"
+    title = "recompile hazard in traced function"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn, _info in module.traced_defs.items():
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                      + fn.args.kwonlyargs)} - {"self"}
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = module.canonical(node.func)
+                if canon not in _SHAPE_CONSTRUCTORS or not node.args:
+                    continue
+                size_args = node.args[:1]
+                for arg in size_args:
+                    bare = _bare_param_reads(arg, params)
+                    if bare:
+                        yield self.finding(
+                            module, node,
+                            f"shape of {canon.rsplit('.', 1)[-1]}() depends "
+                            f"on traced/python parameter {sorted(bare)[0]!r} "
+                            "inside a jitted function — each distinct value "
+                            "recompiles (or fails to trace); derive sizes "
+                            "from .shape or hoist to the host")
+        # static-position literal check at call sites of known jitted fns
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            info = module.jit_info_for_call(callee)
+            if info is None or not info.static_argnums:
+                continue
+            for pos in info.static_argnums:
+                if pos < len(node.args) and isinstance(
+                        node.args[pos], (ast.List, ast.Dict, ast.Set)):
+                    yield self.finding(
+                        module, node.args[pos],
+                        f"non-hashable literal at static_argnums position "
+                        f"{pos} of {callee!r} — static args must be "
+                        "hashable (use a tuple)")
+
+
+def _bare_param_reads(node: ast.AST, params: set[str]) -> set[str]:
+    """Parameter names read under ``node`` EXCLUDING reads through static
+    attributes (``x.shape[0]`` does not count as a bare read of ``x``)."""
+    out: set[str] = set()
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return  # x.shape / x.ndim / x.dtype are static at trace time
+        if isinstance(n, ast.Call):
+            canon_last = last_segment(dotted_name(n.func) or "")
+            if canon_last in ("len", "isinstance", "type", "getattr",
+                              "hasattr"):
+                return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in params:
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(node)
+    return out
+
+
+@register
+class KeyReuseRule(Rule):
+    """RNG01 — the same PRNG key consumed twice.
+
+    Linear scan per function: every ``jax.random.<draw>(key, ...)`` call
+    consumes its key; a second consumption of the same (dotted) name with
+    no reassignment in between fires.  A draw inside a loop whose key is
+    never reassigned in that loop body fires too (silent reuse across
+    iterations — identical "randomness" every step).
+    """
+
+    id = "RNG01"
+    title = "PRNG key reuse without split"
+
+    #: jax.random callables that CONSUME a key (split/fold_in produce
+    #: fresh ones but still consume their input)
+    _NON_DRAWS = {"key", "PRNGKey", "key_data", "wrap_key_data"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, node)
+
+    def _key_arg(self, module: ModuleInfo, call: ast.Call) -> str | None:
+        canon = module.canonical(call.func) or ""
+        if not canon.startswith("jax.random."):
+            return None
+        if canon.rsplit(".", 1)[-1] in self._NON_DRAWS:
+            return None
+        if not call.args:
+            return None
+        return dotted_name(call.args[0])
+
+    def _check_function(self, module: ModuleInfo,
+                        fn: ast.FunctionDef) -> Iterator[Finding]:
+        yield from self._scan(module, fn.body, {}, frozenset())
+
+    @staticmethod
+    def _terminates(body: list[ast.stmt]) -> bool:
+        """Whether control never falls past the end of ``body``."""
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+    def _draws(self, module: ModuleInfo, node: ast.AST,
+               used_once: dict[str, int],
+               skip: frozenset) -> Iterator[Finding]:
+        """Register/flag key consumptions in one expression or simple
+        statement (no statement-level branching below this node)."""
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            key = self._key_arg(module, n)
+            if key is None or key in skip:
+                continue
+            if key in used_once:
+                yield self.finding(
+                    module, n,
+                    f"PRNG key {key!r} already consumed at line "
+                    f"{used_once[key]} with no split/reassign since — two "
+                    "draws from one key produce correlated streams")
+            else:
+                used_once[key] = n.lineno
+
+    def _scan(self, module: ModuleInfo, body: list[ast.stmt],
+              used_once: dict[str, int],
+              skip: frozenset) -> Iterator[Finding]:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                loop_assigned: set[str] = set()
+                for s in body_statements(stmt.body):
+                    loop_assigned.update(statement_targets(s))
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    loop_assigned.update(assigned_names(stmt.target))
+                flagged: set[str] = set()
+                for s in stmt.body:
+                    for n in ast.walk(s):
+                        if isinstance(n, ast.Call):
+                            key = self._key_arg(module, n)
+                            if (key is not None and key not in loop_assigned
+                                    and key not in skip
+                                    and key not in flagged):
+                                flagged.add(key)
+                                yield self.finding(
+                                    module, n,
+                                    f"PRNG key {key!r} is consumed every "
+                                    "loop iteration but never split/"
+                                    "reassigned in the loop — identical "
+                                    "random draws each step")
+                # intra-iteration reuse of keys that ARE rebound per step
+                yield from self._scan(module, stmt.body, {},
+                                      skip | flagged)
+                used_once.clear()
+                continue
+            if isinstance(stmt, ast.If):
+                yield from self._draws(module, stmt.test, used_once, skip)
+                # branches are mutually exclusive: scan each from a copy of
+                # the current state, then merge the states that fall through
+                states: list[dict[str, int]] = []
+                for branch in (stmt.body, stmt.orelse):
+                    st = dict(used_once)
+                    if branch:
+                        yield from self._scan(module, branch, st, skip)
+                    if not branch or not self._terminates(branch):
+                        states.append(st)
+                used_once.clear()
+                for st in states:
+                    used_once.update(st)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from self._draws(module, item.context_expr,
+                                           used_once, skip)
+                for t in statement_targets(stmt):
+                    used_once.pop(t, None)
+                yield from self._scan(module, stmt.body, used_once, skip)
+                continue
+            if isinstance(stmt, ast.Try):
+                merged = dict(used_once)
+                yield from self._scan(module, stmt.body, merged, skip)
+                for handler in stmt.handlers:
+                    hs = dict(used_once)
+                    yield from self._scan(module, handler.body, hs, skip)
+                    merged.update(hs)
+                if stmt.orelse:
+                    yield from self._scan(module, stmt.orelse, merged, skip)
+                if stmt.finalbody:
+                    yield from self._scan(module, stmt.finalbody, merged,
+                                          skip)
+                used_once.clear()
+                used_once.update(merged)
+                continue
+            # simple statement: uses first, then (re)binds
+            yield from self._draws(module, stmt, used_once, skip)
+            for t in statement_targets(stmt):
+                used_once.pop(t, None)
+
+
+@register
+class UseAfterDonateRule(Rule):
+    """DON01 — reading a buffer after donating it to a jitted call.
+
+    For calls to callables with known ``donate_argnums``, the (dotted)
+    names passed at donated positions are dead afterwards unless the same
+    statement rebinds them.  A later read before a rebind fires; a call
+    inside a loop whose donated names are never rebound anywhere in the
+    loop body fires at the call (next iteration reuses the corpse).
+    """
+
+    id = "DON01"
+    title = "use after donate_argnums donation"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, node)
+
+    def _donations(self, module: ModuleInfo,
+                   stmt: ast.stmt) -> list[tuple[ast.Call, str]]:
+        out = []
+        for call in _calls_in(stmt):
+            callee = dotted_name(call.func)
+            if callee is None:
+                continue
+            info = module.jit_info_for_call(callee)
+            if info is None or not info.donate_argnums:
+                continue
+            for pos in info.donate_argnums:
+                if pos < len(call.args):
+                    arg = call.args[pos]
+                    if isinstance(arg, ast.Starred):
+                        continue  # *tables style: rebinding checked coarsely
+                    name = dotted_name(arg)
+                    if name is not None:
+                        out.append((call, name))
+        return out
+
+    def _check_function(self, module: ModuleInfo,
+                        fn: ast.FunctionDef) -> Iterator[Finding]:
+        yield from self._scan(module, fn.body, in_loop=False)
+
+    def _scan(self, module: ModuleInfo, body: list[ast.stmt],
+              in_loop: bool) -> Iterator[Finding]:
+        dead: dict[str, int] = {}       # donated name -> donation line
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            donations = self._donations(module, stmt)
+            rebound = statement_targets(stmt)
+            # reads in this statement happen before its own donation kills
+            # anything, but after PREVIOUS statements' donations
+            reads = names_read(stmt)
+            for name, line in list(dead.items()):
+                if name in reads:
+                    yield Finding(
+                        rule=self.id, path=module.path, line=stmt.lineno,
+                        col=stmt.col_offset + 1,
+                        message=(f"{name!r} was donated to a jitted call at "
+                                 f"line {line} (donate_argnums) and read "
+                                 "again here — the buffer is deleted after "
+                                 "donation; copy first (jnp.array) or "
+                                 "rebind from the call's result"),
+                        code=module.line(stmt.lineno))
+                    dead.pop(name, None)
+            for name in rebound:
+                dead.pop(name, None)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                loop_assigned: set[str] = set()
+                for s in body_statements(stmt.body):
+                    loop_assigned.update(statement_targets(s))
+                for call, name in [d for s in body_statements(stmt.body)
+                                   for d in self._donations(module, s)]:
+                    if name not in loop_assigned:
+                        yield self.finding(
+                            module, call,
+                            f"{name!r} is donated inside a loop but never "
+                            "rebound in the loop body — the next iteration "
+                            "passes a deleted buffer")
+                yield from self._scan(module, stmt.body, in_loop=True)
+                continue
+            for name, line in [(n, c.lineno) for c, n in donations
+                               if n not in rebound]:
+                dead[name] = line
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    yield from self._scan(module, sub, in_loop)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from self._scan(module, handler.body, in_loop)
+
+
+@register
+class TracedBranchRule(Rule):
+    """TB01 — Python control flow on traced values.
+
+    Inside a traced function body, ``if``/``while`` tests that read a
+    parameter of that function concretize a tracer (ConcretizationTypeError
+    at best, value-dependent retraces at worst).  ``is``/``is not`` tests,
+    reads through static attributes (``x.shape``), and ``isinstance``/
+    ``len`` calls are allowed — those are static at trace time.
+    """
+
+    id = "TB01"
+    title = "python branch on traced value"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for fn, info in module.traced_defs.items():
+            static = set(info.static_argnums) if info else set()
+            ordered = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+            params = ({a for i, a in enumerate(ordered) if i not in static}
+                      | {a.arg for a in fn.args.kwonlyargs}) - {"self"}
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                test = node.test
+                if isinstance(test, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops):
+                    continue
+                bare = _bare_param_reads(test, params)
+                if bare:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    yield self.finding(
+                        module, node,
+                        f"python `{kind}` on traced parameter "
+                        f"{sorted(bare)[0]!r} inside a jitted function — "
+                        "use jnp.where/lax.cond/lax.while_loop (or mark "
+                        "the argument static)")
+
+
+@register
+class UninstrumentedHotLoopRule(Rule):
+    """HOT02 — device-dispatching loops invisible to observability.
+
+    A loop that calls a jitted callable (directly, or through a local
+    helper that does) with no ``trace.span``/``METRICS``/timer call
+    anywhere in the loop body or its enclosing function bypasses the PR 1
+    metrics layer: its steps appear in no histogram, no trace, no
+    ``/metrics.prom`` scrape.  One span or counter anywhere in reach —
+    even per-epoch around the loop — satisfies the rule.
+    """
+
+    id = "HOT02"
+    title = "uninstrumented device-dispatching loop"
+
+    @staticmethod
+    def _has_obs(node: ast.AST, module: ModuleInfo) -> bool:
+        for call in _calls_in(node):
+            name = dotted_name(call.func) or ""
+            base, _, attr = name.rpartition(".")
+            if attr in _OBS_MARKERS and (
+                    last_segment(base) in ("trace", "METRICS", "TRACER",
+                                           "registry")
+                    or base.endswith("METRICS") or "observ" in base):
+                return True
+            canon = module.canonical(call.func) or ""
+            if "observability" in canon or canon.endswith(".span"):
+                return True
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            fn_has_obs = self._has_obs(node, module)
+            if fn_has_obs:
+                continue
+            for loop in _function_loops(node):
+                dispatches = None
+                for call in _calls_in(loop):
+                    callee = dotted_name(call.func)
+                    if callee and module.is_dispatching_call(callee):
+                        dispatches = callee
+                        break
+                if dispatches is None:
+                    continue
+                yield self.finding(
+                    module, loop,
+                    f"loop dispatches device work ({dispatches!r}) with no "
+                    "trace.span/METRICS instrumentation in reach — add a "
+                    "span or counter (per-epoch is enough) so the PR 1 "
+                    "observability layer sees this hot path")
+                break  # one finding per function is enough signal

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import METRICS, trace
+
 
 @partial(jax.jit, static_argnums=(2,))
 def _lloyd_step(points, centroids, k):
@@ -47,12 +49,17 @@ class KMeansClustering:
         init_idx = rng.choice(pts.shape[0], self.k, replace=False)
         centroids = pts[jnp.asarray(init_idx)]
         prev = float("inf")
-        for _ in range(self.max_iterations):
-            centroids, _, inertia = _lloyd_step(pts, centroids, self.k)
-            cur = float(inertia)
-            if abs(prev - cur) < self.tol * max(1.0, abs(prev)):
-                break
-            prev = cur
+        with trace.span("kmeans.fit", k=self.k, n=int(pts.shape[0])):
+            for _ in range(self.max_iterations):
+                centroids, _, inertia = _lloyd_step(pts, centroids, self.k)
+                # the relative-tolerance early exit needs the host scalar
+                # every sweep; Lloyd iterations are few and the sync IS the
+                # convergence test  # graftlint: disable=HS01
+                cur = float(inertia)
+                METRICS.increment("kmeans.sweeps")
+                if abs(prev - cur) < self.tol * max(1.0, abs(prev)):
+                    break
+                prev = cur
         # final assignment/inertia against the FINAL centroids (the loop's
         # values lag one update behind), so labels() agrees with predict()
         _, assign, inertia = _lloyd_step(pts, centroids, self.k)

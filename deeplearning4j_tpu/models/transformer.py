@@ -581,6 +581,8 @@ class TransformerLM:
         toks = fn(params, toks0, key,
                   jnp.float32(temperature if not greedy else 1.0),
                   jnp.int32(P), jnp.int32(length))
+        # sample() returns host tokens by contract; this is the one
+        # deliberate end-of-generation pull  # graftlint: disable=HS01
         return [int(t) for t in np.asarray(toks[0, :P + length])]
 
     def score(self, params, tokens, targets) -> float:

@@ -373,8 +373,10 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------ eval
     def evaluate(self, data_or_iter) -> Evaluation:
         ev = Evaluation()
-        for batch in self._as_batches(data_or_iter):
-            ev.eval(batch.labels, np.asarray(self.output(batch.features)))
+        with trace.span("multilayer.evaluate"):
+            for batch in self._as_batches(data_or_iter):
+                ev.eval(batch.labels, np.asarray(self.output(batch.features)))
+                METRICS.increment("evaluate.batches")
         return ev
 
     # ------------------------------------------------------------------ params plumbing

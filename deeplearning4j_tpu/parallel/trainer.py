@@ -59,6 +59,7 @@ except ImportError:  # pragma: no cover - older jax
         return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=check_vma)
 
+from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..datasets.dataset import DataSet
 from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
 from ..observability import sample_device_memory, trace
@@ -144,6 +145,13 @@ class DataParallelTrainer:
         self.average_every = average_every
         self.max_pending = max(1, max_pending)
         self.n_dp = self.mesh.shape[DP]
+        # canonical placements for step arguments: batches split over dp,
+        # scalars replicated.  Dispatch device_puts EVERY argument against
+        # these (a no-op for already-placed arrays), so nothing reaches the
+        # jitted step via an implicit transfer/reshard — the invariant the
+        # hot-loop transfer guard enforces.
+        self._batch_sh = NamedSharding(self.mesh, P(DP))
+        self._rep_sh = NamedSharding(self.mesh, P())
         self._avg_fn = None
         # bucketed jit cache: one compiled step per padded batch size
         self._step_cache: dict[int, Any] = {}
@@ -155,11 +163,19 @@ class DataParallelTrainer:
 
     # ------------------------------------------------------------------ state
     def init_state(self, params, key=None) -> TrainState:
-        key = key if key is not None else jax.random.key(0)
+        if key is None:
+            # seed from an explicitly-placed scalar: works under a caller's
+            # transfer guard (jax.random.key(0) implicitly uploads the int)
+            key = jax.random.key(jax.device_put(np.uint32(0)))
+        key = jax.device_put(key, self._rep_sh)  # replicate once, up front
         # Copy before placement: device_put may alias the caller's buffers as
         # mesh shards, and the jitted step donates its inputs — without this
-        # copy the caller's params would be deleted by the first step.
-        params = jax.tree_util.tree_map(jnp.array, params)
+        # copy the caller's params would be deleted by the first step.  Host
+        # leaves cross over via an EXPLICIT device_put (itself a fresh
+        # buffer), so initializing from numpy works under a transfer guard.
+        params = jax.tree_util.tree_map(
+            lambda a: (jnp.array(a) if isinstance(a, jax.Array)
+                       else jax.device_put(np.asarray(a))), params)
         if self.router == "hogwild":
             # per-worker replicas: stack along a leading dp axis
             params = jax.tree_util.tree_map(
@@ -168,14 +184,27 @@ class DataParallelTrainer:
                 params, NamedSharding(self.mesh, P(DP)))
         else:
             params = jax.device_put(params, NamedSharding(self.mesh, P()))
-        tstate = self.transform.init(
-            jax.tree_util.tree_map(lambda x: x[0], params)
-            if self.router == "hogwild" else params)
+        # transform.init (and the hogwild x[0] slice) build setup-time
+        # constants — zero buffers, gather indices — that a surrounding
+        # transfer guard would reject.  This is one-shot setup, not the hot
+        # loop, and every leaf is explicitly re-placed below, so the
+        # documented escape hatch applies here.
+        with allow_transfers():
+            tstate = self.transform.init(
+                jax.tree_util.tree_map(lambda x: x[0], params)
+                if self.router == "hogwild" else params)
         if self.router == "hogwild":
             tstate = jax.tree_util.tree_map(
                 lambda x: (jnp.broadcast_to(x[None], (self.n_dp,) + x.shape)
                            if isinstance(x, jnp.ndarray) else x), tstate)
             tstate = jax.device_put(tstate, NamedSharding(self.mesh, P(DP)))
+        else:
+            # transform.init builds its buffers eagerly on one device;
+            # replicate them NOW so the first step's call needs no implicit
+            # reshard (the hot-loop transfer guard would reject it)
+            tstate = jax.tree_util.tree_map(
+                lambda x: (jax.device_put(x, self._rep_sh)
+                           if isinstance(x, jnp.ndarray) else x), tstate)
         return TrainState(params=params, tstate=tstate, step=0, key=key)
 
     # ------------------------------------------------------------------ buckets
@@ -210,6 +239,10 @@ class DataParallelTrainer:
                 METRICS.increment("train_step.padded_samples", pad)
             idx = np.arange(pad) % n  # wrap: pad may exceed batch
             lib = jnp if isinstance(x, jnp.ndarray) else np
+            if lib is jnp:
+                # indexing a device array with a host index vector is an
+                # implicit H2D transfer — spell it out (transfer-guard safe)
+                idx = jax.device_put(idx)
             x = lib.concatenate([x, x[idx]])
             y = lib.concatenate([y, y[idx]])
         return x, y, n, bucket
@@ -336,14 +369,26 @@ class DataParallelTrainer:
         with cm:
             step_fn = self._step_for(bucket)
             state.key, sub = jax.random.split(state.key)
+            # every argument crosses to its device placement EXPLICITLY
+            # (device_put, a no-op when already placed): under the hot-loop
+            # transfer guard an implicit jnp.asarray(int) or a numpy batch
+            # leaking into the jitted call would raise on every step
+            x = jax.device_put(x, self._batch_sh)
+            y = jax.device_put(y, self._batch_sh)
             if self.router == "iterative_reduce":
                 params, tstate, loss = step_fn(
-                    state.params, state.tstate, x, y, sub,
-                    jnp.asarray(state.step), jnp.asarray(n_valid, jnp.int32))
+                    state.params, state.tstate, x, y,
+                    jax.device_put(sub, self._rep_sh),
+                    jax.device_put(np.int32(state.step), self._rep_sh),
+                    jax.device_put(np.int32(n_valid), self._rep_sh))
             else:
-                keys = jax.random.split(sub, self.n_dp)
-                iters = jnp.full((self.n_dp,), state.step, jnp.int32)
-                nv = jnp.full((self.n_dp,), n_valid, jnp.int32)
+                keys = jax.device_put(jax.random.split(sub, self.n_dp),
+                                      self._batch_sh)
+                iters = jax.device_put(
+                    np.full((self.n_dp,), state.step, np.int32),
+                    self._batch_sh)
+                nv = jax.device_put(
+                    np.full((self.n_dp,), n_valid, np.int32), self._batch_sh)
                 params, tstate, loss = step_fn(
                     state.params, state.tstate, x, y, keys, iters, nv)
                 if (state.step + 1) % self.average_every == 0:
@@ -460,18 +505,22 @@ class DataParallelTrainer:
                     and checkpoint_manager.latest_step() is not None:
                 state = self.restore(state, checkpoint_manager)
             handles: list[LazyLoss] = []
-            for x, y, n_valid, bucket in self._host_stream(
-                    data, epochs, state.step, prefetch_size):
-                state, lazy = self._dispatch(state, x, y, n_valid, bucket)
-                handles.append(lazy)
-                if not async_dispatch:
-                    self._resolve_pending()  # sync reference path
-                elif resolve_every and len(self._pending) >= resolve_every:
-                    self._resolve_pending()
-                if (checkpoint_manager is not None and checkpoint_every > 0
-                        and state.step % checkpoint_every == 0):
-                    self.checkpoint(state, checkpoint_manager)
-            self._resolve_pending()
+            # steady state runs under the transfer guard: every host<->device
+            # crossing in the loop must be an explicit device_put/device_get
+            # (opt out via DL4J_TPU_TRANSFER_GUARD=0; see analysis.runtime)
+            with hot_loop_guard():
+                for x, y, n_valid, bucket in self._host_stream(
+                        data, epochs, state.step, prefetch_size):
+                    state, lazy = self._dispatch(state, x, y, n_valid, bucket)
+                    handles.append(lazy)
+                    if not async_dispatch:
+                        self._resolve_pending()  # sync reference path
+                    elif resolve_every and len(self._pending) >= resolve_every:
+                        self._resolve_pending()
+                    if (checkpoint_manager is not None and checkpoint_every > 0
+                            and state.step % checkpoint_every == 0):
+                        self.checkpoint(state, checkpoint_manager)
+                self._resolve_pending()
             losses = [h.value() for h in handles]
             if checkpoint_manager is not None and losses:
                 self.checkpoint(state, checkpoint_manager)
@@ -485,8 +534,11 @@ class DataParallelTrainer:
         self._resolve_pending()
         jax.block_until_ready((state.params, state.tstate))
         METRICS.increment("checkpoint.fences")
-        manager.save(state.step, state.params, tstate=state.tstate,
-                     key=state.key, data_cursor=state.step)
+        # the save pulls every leaf to host: a sanctioned sync point, so it
+        # re-allows transfers even when called inside the guarded fit loop
+        with allow_transfers():
+            manager.save(state.step, state.params, tstate=state.tstate,
+                         key=state.key, data_cursor=state.step)
 
     def restore(self, template: TrainState, manager) -> TrainState:
         """Restore the latest checkpoint into a state shaped like
@@ -507,6 +559,10 @@ class DataParallelTrainer:
     def final_params(self, state: TrainState):
         """Collapse to a single param set (average replicas for hogwild)."""
         if self.router == "hogwild":
-            avgd = self._avg_fn(state.params) if self._avg_fn else state.params
-            return jax.tree_util.tree_map(lambda a: a[0], avgd)
+            # one-shot post-fit collapse; the x[0] gather index is a
+            # setup-style constant a surrounding guard would reject
+            with allow_transfers():
+                avgd = (self._avg_fn(state.params) if self._avg_fn
+                        else state.params)
+                return jax.tree_util.tree_map(lambda a: a[0], avgd)
         return state.params

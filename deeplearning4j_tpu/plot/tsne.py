@@ -101,6 +101,9 @@ class Tsne:
         self.kl_: float = float("nan")
 
     def _input_probs(self, x: np.ndarray) -> np.ndarray:
+        # one-shot preprocessing (called once per fit, not per iteration);
+        # the perplexity search below is host code and needs the matrix
+        # graftlint: disable=HS01
         d2 = np.asarray(_pairwise_sq_dists(jnp.asarray(x, jnp.float32)))
         P = binary_search_perplexity(d2, self.perplexity)
         P = (P + P.T) / (2.0 * P.shape[0])

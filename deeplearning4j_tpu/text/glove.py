@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import METRICS, trace
 from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
 from .vocab import VocabCache, build_vocab
 
@@ -101,7 +102,7 @@ class Glove:
                  min_word_frequency: float = 1.0, iterations: int = 5,
                  learning_rate: float = 0.05, x_max: float = 100.0,
                  alpha: float = 0.75, batch_size: int = 8192, seed: int = 42,
-                 tokenizer_factory=None):
+                 resolve_every: int = 32, tokenizer_factory=None):
         self.sentences = list(sentences) if sentences is not None else []
         self.layer_size = layer_size
         self.window = window
@@ -112,6 +113,7 @@ class Glove:
         self.alpha = alpha
         self.batch_size = batch_size
         self.seed = seed
+        self.resolve_every = max(1, resolve_every)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory(
             CommonPreprocessor())
         self.vocab: VocabCache | None = None
@@ -129,11 +131,13 @@ class Glove:
             jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32),
         ]
 
-    def _apply_step(self, rows, cols, logx, fx) -> float:
+    def _apply_step(self, rows, cols, logx, fx):
+        """One AdaGrad batch; returns the DEVICE loss (0-d array) so the
+        caller decides when to pay the host sync (LazyLoss discipline)."""
         *self._tables, loss = _glove_step(
             *self._tables, rows, cols, logx, fx,
             jnp.float32(self.learning_rate))
-        return float(loss)
+        return loss
 
     def _final_embeddings(self, n: int):
         w, wc = self._tables[0], self._tables[1]
@@ -155,13 +159,29 @@ class Glove:
             perm = rng.permutation(m)
             epoch_loss = 0.0
             nb = 0
-            for off in range(0, m, self.batch_size):
-                sl = perm[off:off + self.batch_size]
-                epoch_loss += self._apply_step(
-                    jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
-                    jnp.asarray(logx[sl]), jnp.asarray(fx[sl]))
-                nb += 1
+            pending: list = []   # device losses awaiting one batched sync
+
+            def _resolve() -> None:
+                # pull then accumulate one batch at a time, in dispatch
+                # order: bitwise-identical to per-batch float(loss) sums
+                nonlocal epoch_loss
+                for v in jax.device_get(pending):
+                    epoch_loss += float(v)
+                pending.clear()
+
+            with trace.span("glove.epoch", iteration=it, entries=m):
+                for off in range(0, m, self.batch_size):
+                    sl = perm[off:off + self.batch_size]
+                    pending.append(self._apply_step(
+                        jnp.asarray(rows[sl]), jnp.asarray(cols[sl]),
+                        jnp.asarray(logx[sl]), jnp.asarray(fx[sl])))
+                    nb += 1
+                    METRICS.increment("glove.batches")
+                    if len(pending) >= self.resolve_every:
+                        _resolve()
+                _resolve()
             self.losses.append(epoch_loss / max(1, nb))
+            METRICS.gauge("glove.epoch_loss", self.losses[-1])
         self.syn0 = self._final_embeddings(n)
         return self
 

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import METRICS, trace
 from .sentence import CollectionSentenceIterator
 from .tokenization import CommonPreprocessor, DefaultTokenizerFactory
 from .vocab import Huffman, VocabCache, build_vocab
@@ -234,25 +235,31 @@ class Word2Vec:
                 pairs_total = max(1.0, float(n_pairs) * self.iterations)
             perm = rng.permutation(n_pairs)
             centers, contexts = centers[perm], contexts[perm]
-            for off in range(0, n_pairs, self.batch_size):
-                cb = jnp.asarray(centers[off:off + self.batch_size])
-                xb = jnp.asarray(contexts[off:off + self.batch_size])
-                alpha = max(self.min_learning_rate,
-                            self.learning_rate * (1.0 - pairs_seen / pairs_total))
-                if self.use_hs:
-                    self._apply_hs(cb, points[xb], codes[xb], mask_table[xb],
-                                   jnp.float32(alpha))
-                if self.negative > 0:
-                    key, sub = jax.random.split(key)
-                    negs = _sample_negatives(
-                        sub, self._unigram_log, (cb.shape[0], self.negative))
-                    targets = jnp.concatenate([xb[:, None], negs], axis=1)
-                    labels = jnp.concatenate(
-                        [jnp.ones((cb.shape[0], 1), jnp.float32),
-                         jnp.zeros((cb.shape[0], self.negative), jnp.float32)],
-                        axis=1)
-                    self._apply_ns(cb, targets, labels, jnp.float32(alpha))
-                pairs_seen += cb.shape[0]
+            with trace.span("word2vec.epoch", iteration=it,
+                            pairs=int(n_pairs)):
+                for off in range(0, n_pairs, self.batch_size):
+                    cb = jnp.asarray(centers[off:off + self.batch_size])
+                    xb = jnp.asarray(contexts[off:off + self.batch_size])
+                    alpha = max(
+                        self.min_learning_rate,
+                        self.learning_rate * (1.0 - pairs_seen / pairs_total))
+                    if self.use_hs:
+                        self._apply_hs(cb, points[xb], codes[xb],
+                                       mask_table[xb], jnp.float32(alpha))
+                    if self.negative > 0:
+                        key, sub = jax.random.split(key)
+                        negs = _sample_negatives(
+                            sub, self._unigram_log,
+                            (cb.shape[0], self.negative))
+                        targets = jnp.concatenate([xb[:, None], negs], axis=1)
+                        labels = jnp.concatenate(
+                            [jnp.ones((cb.shape[0], 1), jnp.float32),
+                             jnp.zeros((cb.shape[0], self.negative),
+                                       jnp.float32)],
+                            axis=1)
+                        self._apply_ns(cb, targets, labels, jnp.float32(alpha))
+                    pairs_seen += cb.shape[0]
+                    METRICS.increment("word2vec.batches")
         return self
 
     # ------------------------------------------------------------------ queries

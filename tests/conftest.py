@@ -30,6 +30,29 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "no_implicit_transfers: run the test under "
+        "jax.transfer_guard('disallow') — any implicit host<->device "
+        "transfer inside the test body fails it (hot-loop contract; see "
+        "deeplearning4j_tpu/analysis/runtime.py)")
+
+
+@pytest.fixture(autouse=True)
+def _transfer_guard_marker(request):
+    """Enforce the ``no_implicit_transfers`` marker: the whole test body
+    runs inside ``jax.transfer_guard("disallow")``, so hot-loop tests
+    assert zero implicit transfers in addition to their own checks.  On
+    the CPU backend this catches implicit host->device crossings (D2H is
+    free there — full enforcement happens on real devices)."""
+    if request.node.get_closest_marker("no_implicit_transfers") is None:
+        yield
+        return
+    with jax.transfer_guard("disallow"):
+        yield
+
+
 @pytest.fixture
 def rng_np():
     return np.random.default_rng(42)

@@ -52,6 +52,7 @@ RAGGED = [32, 17, 9, 23, 32, 5, 29, 13]
 
 
 # --------------------------------------------------------------- parity
+@pytest.mark.no_implicit_transfers
 def test_async_fit_matches_sync_fit_on_ragged_batches():
     """Acceptance: async + bucketed == synchronous per-step, same data/seed."""
     params, loss_fn, w, rng = _toy()
@@ -200,6 +201,7 @@ def test_fit_without_prefetch_matches_prefetched():
     np.testing.assert_allclose(l1, l2, atol=1e-6)
 
 
+@pytest.mark.no_implicit_transfers
 def test_hogwild_ragged_fit_smoke():
     params, loss_fn, w, rng = _toy()
     data = _ragged_batches(rng, w, [32, 17, 32, 9])

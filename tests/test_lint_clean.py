@@ -1,0 +1,54 @@
+"""Tier-1 gate: the shipped tree stays graftlint-clean.
+
+This is the test form of ``python -m tools.graftlint --check`` — any new
+hazard (host sync in a hot path, recompile trap, key reuse, use-after-
+donate, traced branch, uninstrumented hot loop) that is neither
+suppressed inline with a reason nor carried in the committed baseline
+fails CI here.  Companion invariants keep the baseline itself honest:
+every entry must still fire (no stale ledger lines) and carry a real
+justification (no TODOs shipped).
+"""
+
+import os
+
+from deeplearning4j_tpu.analysis import Analyzer, Baseline, active
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "graftlint.baseline.json")
+PACKAGE = os.path.join(REPO, "deeplearning4j_tpu")
+
+
+def _run():
+    analyzer = Analyzer(baseline=Baseline.load(BASELINE), root=REPO)
+    findings = analyzer.analyze_paths([PACKAGE])
+    return analyzer, findings
+
+
+def test_package_has_no_new_violations():
+    analyzer, findings = _run()
+    assert analyzer.errors == [], f"unparseable files: {analyzer.errors}"
+    fresh = active(findings)
+    listing = "\n".join(
+        f"  {f.path}:{f.line}: {f.rule} {f.message}" for f in fresh)
+    assert not fresh, (
+        f"{len(fresh)} new graftlint violation(s) — fix them, suppress "
+        f"inline with a reason, or (last resort) baseline with a "
+        f"justification:\n{listing}")
+
+
+def test_baseline_has_no_stale_entries():
+    _, findings = _run()
+    stale = Baseline.load(BASELINE).stale_entries(findings)
+    listing = "\n".join(f"  {e['rule']} {e['path']}: {e['code']!r}"
+                        for e in stale)
+    assert not stale, (
+        f"baseline entries that no longer fire (the hazard was fixed or "
+        f"the line changed) — delete them:\n{listing}")
+
+
+def test_baseline_entries_are_justified():
+    for e in Baseline.load(BASELINE).entries:
+        just = e.get("justification", "")
+        assert just and "TODO" not in just, (
+            f"baseline entry {e['rule']} {e['path']} lacks a real "
+            f"justification: {just!r}")

@@ -1,0 +1,129 @@
+"""graftlint CLI — static JAX/TPU hazard analysis for this repo.
+
+Usage (from the repo root):
+
+    python -m tools.graftlint --check [PATHS...]     # CI gate: fail on NEW
+    python -m tools.graftlint [PATHS...]             # report everything
+    python -m tools.graftlint --json [PATHS...]      # machine-readable
+    python -m tools.graftlint --write-baseline       # accept current state
+
+Defaults: PATHS = ``deeplearning4j_tpu``, baseline =
+``graftlint.baseline.json`` at the repo root.  ``--check`` exits 1 when
+any finding is neither suppressed inline (``# graftlint: disable=RULE``)
+nor carried in the baseline; it also exits 1 on unparseable files.
+``--stale`` lists baseline entries whose finding no longer fires (fixed
+hazards whose ledger entry should be deleted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # `python tools/graftlint.py` direct runs
+    sys.path.insert(0, _REPO_ROOT)
+
+from deeplearning4j_tpu.analysis import (  # noqa: E402
+    Analyzer,
+    Baseline,
+    active,
+    all_rules,
+    emit_metrics,
+    summarize,
+    to_json,
+    to_text,
+)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "graftlint.baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based JAX/TPU hazard analyzer (HS01 host syncs, "
+                    "RC01 recompiles, RNG01 key reuse, DON01 use-after-"
+                    "donate, TB01 traced branches, HOT02 uninstrumented "
+                    "hot loops)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to analyze (default: deeplearning4j_tpu)")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 on any non-suppressed, non-baselined finding")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one machine-readable JSON report on stdout")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default: %(default)s)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current active findings to the baseline "
+                        "(with TODO justifications) and exit 0")
+    p.add_argument("--stale", action="store_true",
+                   help="also report baseline entries that no longer fire")
+    p.add_argument("--all", action="store_true", dest="show_all",
+                   help="text mode: show suppressed/baselined findings too")
+    p.add_argument("--no-metrics", action="store_true",
+                   help="skip publishing graftlint.violations.* gauges")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = args.paths or [os.path.join(_REPO_ROOT, "deeplearning4j_tpu")]
+
+    rules = None
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        registry = all_rules()
+        unknown = wanted - set(registry)
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"known: {', '.join(sorted(registry))}", file=sys.stderr)
+            return 2
+        rules = [registry[r] for r in sorted(wanted)]
+
+    baseline = Baseline.load(args.baseline)
+    analyzer = Analyzer(rules=rules, baseline=baseline, root=_REPO_ROOT)
+    findings = analyzer.analyze_paths(paths)
+
+    if args.write_baseline:
+        Baseline.from_findings(active(findings)).save(args.baseline)
+        print(f"graftlint: wrote {len(active(findings))} entries to "
+              f"{args.baseline}")
+        return 0
+
+    if not args.no_metrics:
+        try:
+            emit_metrics(findings)
+        except Exception:
+            pass  # metrics are best-effort; the lint verdict is the product
+
+    new = active(findings)
+    if args.as_json:
+        payload = to_json(findings, errors=analyzer.errors)
+        if args.stale:
+            payload["stale_baseline_entries"] = baseline.stale_entries(findings)
+        print(json.dumps(payload, indent=2))
+    else:
+        text = to_text(findings, show_all=args.show_all)
+        if text:
+            print(text)
+        for err in analyzer.errors:
+            print(f"graftlint: parse error: {err}", file=sys.stderr)
+        if args.stale:
+            for e in baseline.stale_entries(findings):
+                print(f"graftlint: stale baseline entry "
+                      f"{e['rule']} {e['path']}: {e['code']!r}")
+        s = summarize(findings)
+        print(f"graftlint: {s['total']} finding(s) — {s['active']} active, "
+              f"{s['suppressed']} suppressed, {s['baselined']} baselined")
+
+    if args.check and (new or analyzer.errors):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,6 +45,7 @@ def run(steps: int = 30) -> dict:
     import numpy as np
 
     from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.analysis.runtime import guard_mode
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.observability import METRICS
     from deeplearning4j_tpu.optimize import transforms as T
@@ -80,6 +81,9 @@ def run(steps: int = 30) -> dict:
         "recompiles": recompiles,
         "expected_buckets": n_buckets,
         "n_dp": trainer.n_dp,
+        # fit's steady state ran under jax.transfer_guard(<mode>): any
+        # implicit host<->device transfer would have failed the run
+        "transfer_guard": guard_mode() or "off",
         "losses_finite": all(math.isfinite(l) for l in losses),
         "final_loss": losses[-1] if losses else None,
     }
