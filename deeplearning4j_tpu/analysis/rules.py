@@ -1,4 +1,4 @@
-"""The graftlint rule set — six hazard classes from this repo's history.
+"""The graftlint rule set — seven hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -14,6 +14,9 @@
 |       | function                                                         |
 | HOT02 | loop dispatching device work with no `trace.span`/`METRICS`      |
 |       | instrumentation anywhere in reach (bypasses the PR 1 layer)      |
+| EXC01 | bare `except:` — catches SystemExit/KeyboardInterrupt, so a      |
+|       | retry/supervision loop becomes unkillable and every failure      |
+|       | signal is swallowed untyped                                      |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -562,3 +565,31 @@ class UninstrumentedHotLoopRule(Rule):
                     "span or counter (per-epoch is enough) so the PR 1 "
                     "observability layer sees this hot path")
                 break  # one finding per function is enough signal
+
+
+@register
+class BareExceptRule(Rule):
+    """EXC01 — bare ``except:`` clauses.
+
+    A bare handler catches ``SystemExit``, ``KeyboardInterrupt``, and
+    ``GeneratorExit`` along with everything else.  In this codebase's
+    retry/supervision paths (resilience supervisor, scaleout worker
+    loops) that is exactly wrong twice over: the process becomes
+    unkillable under retry, and the retry policy's ``retry_on`` typing is
+    bypassed — every failure looks retryable.  Catch ``Exception`` (or
+    narrower) instead; if the broad catch is deliberate, re-raise the
+    exit exceptions first.
+    """
+
+    id = "EXC01"
+    title = "bare except swallows exit signals"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield self.finding(
+                    module, node,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt — "
+                    "a retry loop built on this cannot be killed and treats "
+                    "every failure as retryable; catch Exception (or the "
+                    "policy's retry_on tuple) instead")

@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with integrity verification.
 
 Exceeds the reference (SURVEY.md §5.4: java-serialized params only, no
 optimizer state or data cursor — ``DefaultModelSaver``,
@@ -7,14 +7,24 @@ optimizer state or data cursor — ``DefaultModelSaver``,
 rotation and atomic writes.  Storage is a directory of npz payloads + JSON
 metadata — host-side, mesh-agnostic (arrays are gathered to host before
 write; on restore the trainer re-places them onto its mesh).
+
+Integrity (DESIGN.md §12): every payload file's SHA-256 lands in
+``meta.json`` at save time; ``verify()`` recomputes them, and a restore
+with no explicit step walks BACK from the newest checkpoint to the newest
+one that verifies — a truncated or bit-flipped checkpoint is detected and
+skipped (``checkpoint.corrupt_detected``), never silently loaded.  The
+``checkpoint.write`` fault site corrupts the payload *after* checksums are
+recorded, so the whole detection path is testable in-process.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import shutil
 import tempfile
+import warnings
 from pathlib import Path
 from typing import Any
 
@@ -23,6 +33,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import METRICS, trace
+from ..resilience.faults import FAULTS, corrupt_file
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly-requested checkpoint failed checksum verification."""
+
+    def __init__(self, step: int, directory):
+        super().__init__(
+            f"checkpoint step {step} under {directory} failed checksum "
+            "verification — refusing to restore corrupt state")
+        self.step = step
 
 
 def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
@@ -37,13 +58,31 @@ def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
 def _restore_like(template, arrays: dict[str, np.ndarray]):
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
+    used = set()
     for path, leaf in flat:
         key = "/".join(str(p) for p in path)
         if key not in arrays:
             raise KeyError(f"checkpoint missing leaf {key}")
+        used.add(key)
         arr = arrays[key]
-        leaves.append(jnp.asarray(arr) if isinstance(leaf, (jnp.ndarray, np.ndarray))
-                      else type(leaf)(arr.item()))
+        if isinstance(leaf, (jnp.ndarray, np.ndarray)):
+            leaves.append(jnp.asarray(arr))
+        elif leaf is None:
+            # a registered-leaf None (custom pytrees): NoneType() is not
+            # callable with an argument — restore the None itself
+            leaves.append(None)
+        elif isinstance(leaf, (bool, np.bool_)):
+            leaves.append(bool(arr.item()))
+        else:
+            leaves.append(type(leaf)(arr.item()))
+    unused = sorted(set(arrays) - used)
+    if unused:
+        # template drift: the checkpoint carries leaves this template does
+        # not — restoring would silently drop state, so say so loudly
+        warnings.warn(
+            f"checkpoint contains {len(unused)} key(s) absent from the "
+            f"restore template (ignored): {unused[:5]}", stacklevel=3)
+        METRICS.increment("checkpoint.unused_keys", len(unused))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -78,16 +117,26 @@ class CheckpointManager:
             np.savez(tmp / "params.npz", **_flatten_with_paths(params))
             if tstate is not None:
                 np.savez(tmp / "tstate.npz", **_flatten_with_paths(tstate))
+            if key is not None:
+                np.save(tmp / "key.npy", np.asarray(jax.random.key_data(key)))
+            payloads = sorted(p for p in tmp.iterdir() if p.is_file())
             meta = {
                 "step": step,
                 "data_cursor": data_cursor,
                 "has_tstate": tstate is not None,
                 "has_key": key is not None,
                 "extra": extra or {},
+                # per-file SHA-256 manifest: verify() recomputes these; a
+                # checkpoint whose payloads do not match is never restored
+                "checksums": {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
+                              for p in payloads},
             }
-            if key is not None:
-                np.save(tmp / "key.npy", np.asarray(jax.random.key_data(key)))
             (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+            # chaos seam: damage the payload AFTER the manifest is written,
+            # exactly like a torn write / bad medium under the checksums
+            spec = FAULTS.check("checkpoint.write", step)
+            if spec is not None:
+                corrupt_file(tmp / "params.npz", spec.kind)
             if ckpt_dir.exists():
                 shutil.rmtree(ckpt_dir)
             os.replace(tmp, ckpt_dir)  # atomic publish
@@ -116,9 +165,45 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    # ------------------------------------------------------------------ verify
+    def verify(self, step: int) -> bool:
+        """Recompute every payload file's SHA-256 against the ``meta.json``
+        manifest.  Unreadable/unparseable metadata counts as corrupt;
+        pre-checksum checkpoints (no manifest) pass vacuously."""
+        ckpt_dir = self.directory / f"ckpt_{step:010d}"
+        try:
+            meta = json.loads((ckpt_dir / "meta.json").read_text())
+        except (OSError, json.JSONDecodeError):
+            return False
+        checksums = meta.get("checksums")
+        if checksums is None:
+            return True
+        for name, digest in checksums.items():
+            try:
+                data = (ckpt_dir / name).read_bytes()
+            except OSError:
+                return False
+            if hashlib.sha256(data).hexdigest() != digest:
+                return False
+        METRICS.increment("checkpoint.verifications")
+        return True
+
+    def latest_valid_step(self) -> int | None:
+        """Newest step that passes :meth:`verify` (the restore target)."""
+        for step in reversed(self.all_steps()):
+            if self.verify(step):
+                return step
+        return None
+
     def restore(self, params_template, tstate_template=None,
                 step: int | None = None) -> dict:
-        """Returns dict(step, params, tstate, key, data_cursor, extra)."""
+        """Returns dict(step, params, tstate, key, data_cursor, extra).
+
+        With ``step=None`` walks back from the newest checkpoint to the
+        newest one that verifies, skipping (and counting) corrupt ones;
+        an explicit ``step`` that fails verification raises
+        :class:`CheckpointCorruptError` instead of loading garbage.
+        """
         with trace.span("checkpoint.restore"), \
                 METRICS.time("checkpoint.restore"):
             out = self._restore(params_template, tstate_template, step)
@@ -127,9 +212,27 @@ class CheckpointManager:
 
     def _restore(self, params_template, tstate_template=None,
                  step: int | None = None) -> dict:
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if step is not None:
+            if not self.verify(step):
+                METRICS.increment("checkpoint.corrupt_detected")
+                raise CheckpointCorruptError(step, self.directory)
+        else:
+            steps = self.all_steps()
+            if not steps:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            for s in reversed(steps):
+                if self.verify(s):
+                    step = s
+                    break
+                METRICS.increment("checkpoint.corrupt_detected")
+                warnings.warn(
+                    f"checkpoint step {s} under {self.directory} failed "
+                    "checksum verification — falling back to an older "
+                    "checkpoint", stacklevel=4)
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {self.directory} passed "
+                    "verification (all corrupt)")
         ckpt_dir = self.directory / f"ckpt_{step:010d}"
         meta = json.loads((ckpt_dir / "meta.json").read_text())
         params_npz = np.load(ckpt_dir / "params.npz")

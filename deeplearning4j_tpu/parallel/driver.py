@@ -26,6 +26,7 @@ from typing import Iterable
 import jax
 
 from ..observability import METRICS, StatusServer, sample_device_memory, trace
+from ..resilience import RetryPolicy, TrainingSupervisor
 from .checkpoint import CheckpointManager
 from .mesh import MeshSpec, initialize_multihost, make_mesh
 from .trainer import DataParallelTrainer, TrainState
@@ -38,12 +39,18 @@ class Driver:
     pass ``multihost=True`` to join a ``jax.distributed`` cluster first
     (env-var contract, see ``initialize_multihost``) so the same driver
     program runs on every host of a pod slice.
+
+    ``retry_policy`` (requires ``checkpoint_dir``) routes ``run`` through
+    a :class:`~..resilience.TrainingSupervisor`: bounded retry with
+    backoff, resume from the newest valid checkpoint, NaN/Inf rollback,
+    and SIGTERM/SIGINT emergency checkpointing (DESIGN.md §12).
     """
 
     def __init__(self, loss_fn, transform, *, mesh_spec: MeshSpec | None = None,
                  multihost: bool = False, router: str = "iterative_reduce",
                  checkpoint_dir: str | Path | None = None,
-                 checkpoint_every: int = 0, status_port: int | None = None):
+                 checkpoint_every: int = 0, status_port: int | None = None,
+                 retry_policy: RetryPolicy | None = None):
         if multihost:
             initialize_multihost()
         if mesh_spec is None:
@@ -59,6 +66,11 @@ class Driver:
         self.checkpoint_manager = (CheckpointManager(checkpoint_dir)
                                    if checkpoint_dir else None)
         self.checkpoint_every = checkpoint_every
+        if retry_policy is not None and self.checkpoint_manager is None:
+            raise ValueError(
+                "retry_policy requires checkpoint_dir — supervised recovery "
+                "resumes from checkpoints")
+        self.retry_policy = retry_policy
         self.status_server = None
         if status_port is not None:
             self.status_server = StatusServer(port=status_port).start()
@@ -66,15 +78,27 @@ class Driver:
     def run(self, params, batches: Iterable, *, epochs: int = 1,
             resume: bool = True, key=None) -> tuple[TrainState, list[float]]:
         """Fit to completion (with auto-resume when a checkpoint manager is
-        configured); returns the final state and per-step losses."""
+        configured); returns the final state and per-step losses.
+
+        With a ``retry_policy``, runs under the self-healing supervisor —
+        ``batches`` must then be re-iterable (a retried attempt replays
+        the stream from the checkpoint's data cursor)."""
         with trace.span("driver.run", epochs=epochs):
-            state = self.trainer.init_state(params, key=key)
-            # fit streams any iterable — no list() materialization; one-shot
-            # generators make a single pass (multi-epoch needs re-iterables)
-            state, losses = self.trainer.fit(
-                state, batches, epochs=epochs,
-                checkpoint_manager=self.checkpoint_manager,
-                checkpoint_every=self.checkpoint_every, resume=resume)
+            if self.retry_policy is not None:
+                supervisor = TrainingSupervisor(
+                    self.checkpoint_manager, self.retry_policy)
+                state, losses = supervisor.fit(
+                    self.trainer, params, batches, epochs=epochs,
+                    checkpoint_every=max(1, self.checkpoint_every), key=key)
+            else:
+                state = self.trainer.init_state(params, key=key)
+                # fit streams any iterable — no list() materialization;
+                # one-shot generators make a single pass (multi-epoch
+                # needs re-iterables)
+                state, losses = self.trainer.fit(
+                    state, batches, epochs=epochs,
+                    checkpoint_manager=self.checkpoint_manager,
+                    checkpoint_every=self.checkpoint_every, resume=resume)
         METRICS.increment("driver.steps", len(losses))
         if losses:
             METRICS.gauge("driver.loss", losses[-1])

@@ -18,12 +18,14 @@ uses for ``WorkerPerformerFactory`` (``MasterActor.java:166-180``).
 from __future__ import annotations
 
 import importlib
+import os
 import subprocess
 import sys
 import time
 from pathlib import Path
 from typing import Any
 
+from ..resilience.faults import FAULTS, WorkerKilled
 from .procstate import FileStateTracker
 from .scaleout import DistributedRunner, IterativeReduceWorkRouter
 
@@ -58,7 +60,22 @@ def worker_loop(state_dir: str, worker_id: str, performer_spec: str,
         if job is None:
             time.sleep(poll_s)
             continue
-        performer.perform(job)
+        # chaos seams (armed via DL4J_TPU_FAULTS, inherited through the
+        # spawn env): silent process death / straggler / transient failure
+        FAULTS.maybe_fire("scaleout.worker")
+        slow = FAULTS.check("scaleout.worker.slow")
+        if slow is not None:
+            time.sleep(slow.delay_s)
+        try:
+            FAULTS.maybe_fire("scaleout.perform")
+            performer.perform(job)
+        except WorkerKilled:
+            raise                # injected silent death: no failure report
+        except Exception as e:
+            # prompt failure report — the master re-routes the job without
+            # waiting out the heartbeat timeout; this process then exits
+            tracker.record_failure(worker_id, job, repr(e))
+            raise
         if job.result is not None:
             tracker.add_update(worker_id, job.result)
         tracker.clear_job(worker_id)
@@ -77,50 +94,71 @@ class ProcessDistributedRunner(DistributedRunner):
                  n_workers: int = 2, router_cls=IterativeReduceWorkRouter,
                  heartbeat_s: float = 0.05, poll_s: float = 0.02,
                  eviction_timeout_s: float = 2.0,
-                 model_saver=None, worker_env: dict[str, str] | None = None):
+                 model_saver=None, worker_env: dict[str, str] | None = None,
+                 max_job_attempts: int = 3, job_timeout_s: float = 0.0,
+                 max_respawns: int = 0, on_timeout: str = "raise"):
         tracker = FileStateTracker(state_dir)
         super().__init__(job_iterator, performer_factory=None,
                          n_workers=n_workers, router_cls=router_cls,
                          tracker=tracker, model_saver=model_saver,
                          heartbeat_s=heartbeat_s, poll_s=poll_s,
-                         eviction_timeout_s=eviction_timeout_s)
+                         eviction_timeout_s=eviction_timeout_s,
+                         max_job_attempts=max_job_attempts,
+                         job_timeout_s=job_timeout_s,
+                         max_respawns=max_respawns, on_timeout=on_timeout)
         self.state_dir = str(state_dir)
         self.performer_spec = performer_spec
         self.worker_env = worker_env
         self._procs: list[subprocess.Popen] = []
+        self._spawned_wids: list[str] = []
 
     def worker_processes(self) -> list[subprocess.Popen]:
         """Live Popen handles (tests use these to SIGKILL a worker)."""
         return list(self._procs)
 
-    def _spawn_workers(self) -> None:
-        import os
+    def _spawn_one(self, wid: str) -> None:
         env = dict(os.environ)
         if self.worker_env:
             env.update(self.worker_env)
         # make the package importable in the worker regardless of master cwd
         pkg_root = str(Path(__file__).resolve().parents[2])
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
-        for i in range(self.n_workers):
-            wid = f"worker-{i}"
-            self.tracker.add_worker(wid)
-            log = open(Path(self.state_dir) / f"{wid}.log", "wb")
-            self._procs.append(subprocess.Popen(
-                [sys.executable, "-m", "deeplearning4j_tpu.parallel.worker_main",
-                 self.state_dir, wid, self.performer_spec,
-                 str(self.heartbeat_s), str(self.poll_s)],
-                env=env, stdout=log, stderr=subprocess.STDOUT))
-        # boot barrier: heartbeats (and thus eviction eligibility) only
-        # mean something once every worker process is actually up
-        deadline = time.time() + 120.0
+        self.tracker.add_worker(wid)
+        log = open(Path(self.state_dir) / f"{wid}.log", "wb")
+        self._procs.append(subprocess.Popen(
+            [sys.executable, "-m", "deeplearning4j_tpu.parallel.worker_main",
+             self.state_dir, wid, self.performer_spec,
+             str(self.heartbeat_s), str(self.poll_s)],
+            env=env, stdout=log, stderr=subprocess.STDOUT))
+        self._spawned_wids.append(wid)
+
+    def _await_boot(self, wids: list[str], timeout_s: float) -> None:
+        """Boot barrier: heartbeats (and thus eviction eligibility) only
+        mean something once the worker process is actually up —
+        interpreter startup can take seconds (site hooks import heavy
+        deps), so the staleness clock restarts when boot completes."""
+        deadline = time.time() + timeout_s
         boot = Path(self.state_dir) / "boot"
         while time.time() < deadline:
-            if all((boot / f"worker-{i}").exists()
-                   for i in range(self.n_workers)):
+            if all((boot / w).exists() for w in wids):
                 break
             time.sleep(0.05)
-        for i in range(self.n_workers):
-            self.tracker.heartbeat(f"worker-{i}")   # restart staleness clock
+        for w in wids:
+            self.tracker.heartbeat(w)   # restart staleness clock
+
+    def _spawn_workers(self) -> None:
+        super()._spawn_workers()        # parallel Popen via _spawn_one
+        self._await_boot(list(self._spawned_wids), 120.0)
+
+    def _maybe_respawn(self) -> None:
+        before = set(self._spawned_wids)
+        super()._maybe_respawn()
+        new = [w for w in self._spawned_wids if w not in before]
+        if new:
+            # replacement processes boot serially with the master waiting —
+            # bounded (respawn is rare and capped), and without the wait a
+            # short eviction timeout would evict the replacement mid-boot
+            self._await_boot(new, 30.0)
 
     def _shutdown_workers(self) -> None:
         self.tracker.finish()          # workers exit their loop on DONE

@@ -102,7 +102,8 @@ class FileStateTracker:
     def __init__(self, directory: Path | str):
         self.dir = Path(directory)
         for sub in ("workers", "heartbeats", "jobs", "updates", "saved",
-                    "replicate", "disabled", "counters", "boot"):
+                    "replicate", "disabled", "counters", "boot",
+                    "failed", "quarantined"):
             (self.dir / sub).mkdir(parents=True, exist_ok=True)
         self.update_saver = FileUpdateSaver(self.dir / "updates")
         self.work_retriever = FileWorkRetriever(self.dir / "saved")
@@ -179,6 +180,48 @@ class FileStateTracker:
 
     def load_for_worker(self, worker_id: str):
         return self.work_retriever.load(worker_id)
+
+    # -- failures / quarantine ------------------------------------------
+    def record_failure(self, worker_id: str, job, error: str = "") -> None:
+        """Prompt failure report (``scaleout.StateTracker`` parity).
+
+        Write order matters for the master's finish check: the failed
+        record must exist BEFORE the in-flight job file disappears, so
+        the master can never observe 'no jobs, no failures' mid-report.
+        """
+        job.last_error = error
+        name = f"{worker_id}.{os.getpid()}.{time.monotonic_ns()}"
+        _atomic_pickle(self.dir / "failed" / name, (worker_id, job, error))
+        self.clear_job(worker_id)
+
+    def take_failed(self) -> list:
+        out = []
+        for p in sorted((self.dir / "failed").iterdir()):
+            if ".tmp" in p.name:
+                continue
+            rec = _load_pickle(p)
+            if rec is not None:
+                out.append(rec)
+            p.unlink(missing_ok=True)
+        return out
+
+    def has_failures(self) -> bool:
+        return any(".tmp" not in p.name
+                   for p in (self.dir / "failed").iterdir())
+
+    def quarantine(self, job) -> None:
+        name = f"{os.getpid()}.{time.monotonic_ns()}"
+        _atomic_pickle(self.dir / "quarantined" / name, job)
+
+    def quarantined(self) -> list:
+        out = []
+        for p in sorted((self.dir / "quarantined").iterdir()):
+            if ".tmp" in p.name:
+                continue
+            job = _load_pickle(p)
+            if job is not None:
+                out.append(job)
+        return out
 
     # -- updates (file-backed spill) ------------------------------------
     def add_update(self, worker_id: str, update: Any) -> None:

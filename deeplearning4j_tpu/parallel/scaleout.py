@@ -26,7 +26,9 @@ jobs) and the reference's test pattern ("distributed-without-a-cluster",
 
 from __future__ import annotations
 
+import os
 import pickle
+import tempfile
 import threading
 import time
 from collections import defaultdict
@@ -37,17 +39,42 @@ from typing import Any, Callable, Iterator, Protocol, Sequence
 import numpy as np
 
 from ..observability import METRICS, trace
+from ..resilience.faults import FAULTS, WorkerKilled
+
+
+class ScaleoutTimeout(RuntimeError):
+    """``DistributedRunner.run`` hit ``max_wall_s`` with work outstanding.
+
+    ``partial`` carries the tracker's current model at expiry so a caller
+    that wants best-effort results can still read them — but the default
+    is to RAISE: a run that silently returns half-trained state on
+    deadline is indistinguishable from success (DESIGN.md §12).
+    """
+
+    def __init__(self, max_wall_s: float, partial: Any = None):
+        super().__init__(
+            f"scaleout run exceeded max_wall_s={max_wall_s:g}s with jobs "
+            "outstanding (partial result attached as .partial)")
+        self.partial = partial
 
 
 # --------------------------------------------------------------------------- jobs
 
 @dataclass
 class Job:
-    """Serializable work unit (``scaleout/job/Job.java``)."""
+    """Serializable work unit (``scaleout/job/Job.java``).
+
+    ``attempts`` counts failed executions (incremented by the master on
+    each requeue); at ``max_job_attempts`` the job is quarantined instead
+    of re-routed — a poison job cannot take the whole run down with it.
+    """
 
     work: Any
     worker_id: str = ""
     result: Any = None
+    job_id: str = ""
+    attempts: int = 0
+    last_error: str = ""
 
 
 class JobIterator(Protocol):
@@ -155,6 +182,8 @@ class StateTracker:
         self._needs_replicate: set[str] = set()
         self._done = False
         self._saved_workers: dict[str, Job] = {} # job persistence for re-retrieval
+        self._failed: list[tuple[str, Job, str]] = []   # prompt failure reports
+        self._quarantined: list[Job] = []               # poison jobs, retired
         self.update_listeners: list[Callable[[Any], None]] = []
 
     # -- workers --------------------------------------------------------
@@ -236,6 +265,33 @@ class StateTracker:
         """Job re-retrieval after worker restart (``WorkRetriever``)."""
         with self._lock:
             return self._saved_workers.get(worker_id)
+
+    # -- failures / quarantine ------------------------------------------
+    def record_failure(self, worker_id: str, job: Job, error: str = "") -> None:
+        """Prompt failure report from a dying worker: atomically moves the
+        job from in-flight to the failed queue, so the master re-routes it
+        on the next poll instead of waiting out the heartbeat timeout."""
+        with self._lock:
+            job.last_error = error
+            self._jobs.pop(worker_id, None)
+            self._failed.append((worker_id, job, error))
+
+    def take_failed(self) -> list[tuple[str, Job, str]]:
+        with self._lock:
+            out, self._failed = self._failed, []
+            return out
+
+    def has_failures(self) -> bool:
+        with self._lock:
+            return bool(self._failed)
+
+    def quarantine(self, job: Job) -> None:
+        with self._lock:
+            self._quarantined.append(job)
+
+    def quarantined(self) -> list[Job]:
+        with self._lock:
+            return list(self._quarantined)
 
     # -- updates --------------------------------------------------------
     def add_update(self, worker_id: str, update: Any) -> None:
@@ -356,16 +412,32 @@ class ModelSaver(Protocol):
 
 
 class FileModelSaver:
-    """``DefaultModelSaver`` — pickle to a file, atomic replace."""
+    """``DefaultModelSaver`` — pickle to a file, atomic replace.
+
+    Each save writes a UNIQUE temp file in the target directory (two
+    concurrent savers on the same path previously raced on one shared
+    ``.tmp`` name — a torn mix of both pickles could be published) and
+    fsyncs before the rename, so the published file is always one
+    complete, durable pickle.
+    """
 
     def __init__(self, path: str | Path):
         self.path = Path(path)
 
     def save(self, model: Any) -> None:
-        tmp = self.path.with_suffix(".tmp")
-        with open(tmp, "wb") as f:
-            pickle.dump(model, f)
-        tmp.replace(self.path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd = tempfile.NamedTemporaryFile(
+            dir=self.path.parent, prefix=self.path.name + ".",
+            suffix=".tmp", delete=False)
+        try:
+            with fd as f:
+                pickle.dump(model, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(fd.name, self.path)
+        except Exception:
+            Path(fd.name).unlink(missing_ok=True)
+            raise
 
     def load(self) -> Any:
         with open(self.path, "rb") as f:
@@ -390,7 +462,11 @@ class DistributedRunner:
                  tracker: StateTracker | None = None,
                  model_saver: ModelSaver | None = None,
                  heartbeat_s: float = 0.05, poll_s: float = 0.02,
-                 eviction_timeout_s: float = 120.0):
+                 eviction_timeout_s: float = 120.0,
+                 max_job_attempts: int = 3, job_timeout_s: float = 0.0,
+                 max_respawns: int = 0, on_timeout: str = "raise"):
+        if on_timeout not in ("raise", "return"):
+            raise ValueError(f"on_timeout must be 'raise' or 'return', got {on_timeout!r}")
         self.job_iterator = job_iterator
         self.performer_factory = performer_factory
         self.n_workers = n_workers
@@ -400,8 +476,23 @@ class DistributedRunner:
         self.heartbeat_s = heartbeat_s
         self.poll_s = poll_s
         self.eviction_timeout_s = eviction_timeout_s
+        # resilience knobs (DESIGN.md §12): per-job retry budget before
+        # quarantine, optional per-job execution deadline (0 = disabled;
+        # enabling it trades exactly-once for at-most-``max_job_attempts``
+        # execution — a timed-out worker may still finish concurrently),
+        # and a replacement-worker budget (0 = no respawn: the pool only
+        # shrinks on failure, which keeps iterative-reduce wave averages
+        # comparable across a death; raise it when capacity matters more
+        # than wave composition, or when every worker can crash)
+        self.max_job_attempts = max(1, max_job_attempts)
+        self.job_timeout_s = job_timeout_s
+        self.max_respawns = max_respawns
+        self.on_timeout = on_timeout
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._dispatched_at: dict[str, float] = {}  # worker -> dispatch time
+        self._worker_seq = 0
+        self._respawned = 0
 
     # -- worker loop ----------------------------------------------------
     def _worker_loop(self, worker_id: str):
@@ -420,8 +511,27 @@ class DistributedRunner:
             if job is None:
                 time.sleep(self.poll_s)
                 continue
-            with METRICS.time("scaleout.job"):
-                performer.perform(job)
+            # chaos seams: silent death (thread exits, job still assigned,
+            # heartbeats stop — the eviction path must recover) and the
+            # straggler simulation (injected sleep before performing)
+            FAULTS.maybe_fire("scaleout.worker")
+            slow = FAULTS.check("scaleout.worker.slow")
+            if slow is not None:
+                time.sleep(slow.delay_s)
+            try:
+                with METRICS.time("scaleout.job"):
+                    FAULTS.maybe_fire("scaleout.perform")
+                    performer.perform(job)
+            except WorkerKilled:
+                raise            # injected silent death: no failure report
+            except Exception as e:
+                # prompt failure report: the master re-routes the job on
+                # its next poll instead of waiting out the heartbeat
+                # timeout; the worker thread still dies (its performer
+                # state is suspect) and a replacement is spawned
+                self.tracker.record_failure(worker_id, job, repr(e))
+                METRICS.increment("scaleout.job_failures")
+                raise
             if job.result is not None:
                 self.tracker.add_update(worker_id, job.result)
             self.tracker.clear_job(worker_id)
@@ -429,13 +539,30 @@ class DistributedRunner:
 
     # -- worker lifecycle (subclass seam: ProcessDistributedRunner spawns
     #    OS processes here instead of threads) ---------------------------
+    def _spawn_one(self, wid: str) -> None:
+        self.tracker.add_worker(wid)
+        t = threading.Thread(target=self._worker_loop, args=(wid,), daemon=True)
+        self._threads.append(t)
+        t.start()
+
     def _spawn_workers(self) -> None:
-        for i in range(self.n_workers):
-            wid = f"worker-{i}"
-            self.tracker.add_worker(wid)
-            t = threading.Thread(target=self._worker_loop, args=(wid,), daemon=True)
-            self._threads.append(t)
-            t.start()
+        for _ in range(self.n_workers):
+            wid = f"worker-{self._worker_seq}"
+            self._worker_seq += 1
+            self._spawn_one(wid)
+
+    def _maybe_respawn(self) -> None:
+        """Top the pool back up to ``n_workers`` after deaths/evictions,
+        bounded by ``max_respawns`` (a deterministic crash loop must run
+        out of budget, not respawn forever)."""
+        live = len(self.tracker.workers())
+        while live < self.n_workers and self._respawned < self.max_respawns:
+            wid = f"worker-{self._worker_seq}"
+            self._worker_seq += 1
+            self._respawned += 1
+            self._spawn_one(wid)
+            METRICS.increment("scaleout.workers_respawned")
+            live += 1
 
     def _shutdown_workers(self) -> None:
         self._stop.set()
@@ -455,17 +582,52 @@ class DistributedRunner:
         with trace.span("scaleout.run", n_workers=self.n_workers):
             return self._run(max_wall_s)
 
+    def _requeue_or_quarantine(self, job: Job, requeue: list[Job]) -> None:
+        """One more failed attempt for ``job``: re-route it while it has
+        retry budget, quarantine it when it runs out (a poison job must
+        not wedge the run)."""
+        job.attempts += 1
+        if job.attempts >= self.max_job_attempts:
+            self.tracker.quarantine(job)
+            METRICS.increment("scaleout.jobs_quarantined")
+        else:
+            requeue.append(job)
+            METRICS.increment("scaleout.jobs_requeued")
+
     def _run(self, max_wall_s: float) -> Any:
         self.tracker.reset_done()    # a prior run's DONE must not no-op us
         METRICS.increment("scaleout.runs")
         self._spawn_workers()
         deadline = time.time() + max_wall_s
         last_evict = time.time()
-        requeue: list[Job] = []  # orphaned jobs from evicted workers
+        requeue: list[Job] = []  # orphaned/failed jobs awaiting re-dispatch
+        completed = False
         try:
             while time.time() < deadline:
                 if self.tracker.is_done():
+                    completed = True
                     break            # external kill (Kill CLI / finish flag)
+                # prompt failure reports: the reporting worker's thread (or
+                # process) is gone — drop it now and re-route its job,
+                # without waiting out the heartbeat timeout
+                for wid, job, _err in self.tracker.take_failed():
+                    self.tracker.remove_worker(wid)
+                    self._dispatched_at.pop(wid, None)
+                    self._requeue_or_quarantine(job, requeue)
+                # per-job execution deadline (opt-in): a wedged worker is
+                # treated like a dead one — removed and its job re-routed
+                if self.job_timeout_s > 0:
+                    now = time.time()
+                    for wid, t0 in list(self._dispatched_at.items()):
+                        if now - t0 <= self.job_timeout_s:
+                            continue
+                        job = self.tracker.job_for(wid)
+                        self._dispatched_at.pop(wid, None)
+                        if job is None:
+                            continue  # finished right at the deadline
+                        self.tracker.remove_worker(wid)
+                        METRICS.increment("scaleout.job_timeouts")
+                        self._requeue_or_quarantine(job, requeue)
                 # eviction sweep (reference: every 60 s; scaled to poll rate);
                 # orphaned in-flight jobs are re-routed to live workers
                 if time.time() - last_evict > max(1.0, self.eviction_timeout_s / 2):
@@ -473,10 +635,13 @@ class DistributedRunner:
                     evicted, orphans = self.tracker.evict_stale(self.eviction_timeout_s)
                     if evicted:
                         METRICS.increment("scaleout.workers_evicted", len(evicted))
-                    if orphans:
-                        METRICS.increment("scaleout.jobs_requeued", len(orphans))
-                    requeue.extend(orphans)
+                        for wid in evicted:
+                            self._dispatched_at.pop(wid, None)
+                    for job in orphans:
+                        self._requeue_or_quarantine(job, requeue)
                     last_evict = time.time()
+                # top the pool back up after deaths/evictions (bounded)
+                self._maybe_respawn()
                 if self.router.send_work():
                     self.router.update()
                     if self.model_saver is not None:
@@ -501,11 +666,13 @@ class DistributedRunner:
                         continue
                     job.worker_id = wid
                     self.tracker.add_job(job)
+                    self._dispatched_at[wid] = time.time()
                     METRICS.increment("scaleout.jobs_dispatched")
                     dispatched = True
                 if (not self.job_iterator.has_next()
                         and not requeue
                         and not self.tracker.current_jobs()
+                        and not self.tracker.has_failures()
                         and not dispatched):
                     # drain final updates
                     if self.tracker.updates():
@@ -515,8 +682,15 @@ class DistributedRunner:
                             if current is not None:
                                 self.model_saver.save(current)
                     self.tracker.finish()
+                    completed = True
                     break
                 time.sleep(self.poll_s)
         finally:
             self._shutdown_workers()
+        if not completed:
+            # the old behavior — returning half-finished state on deadline
+            # as if nothing happened — was indistinguishable from success
+            METRICS.increment("scaleout.run_timeouts")
+            if self.on_timeout == "raise":
+                raise ScaleoutTimeout(max_wall_s, partial=self.tracker.get_current())
         return self.tracker.get_current()

@@ -61,6 +61,7 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..datasets.dataset import DataSet
+from ..resilience.faults import FAULTS, DivergenceError
 from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
 from ..observability import sample_device_memory, trace
 from ..optimize import transforms as tfm
@@ -156,9 +157,12 @@ class DataParallelTrainer:
         # bucketed jit cache: one compiled step per padded batch size
         self._step_cache: dict[int, Any] = {}
         self._nominal: int | None = None
-        # pending-loss ring: (LazyLoss, n_real_samples) awaiting resolution
-        self._pending: list[tuple[LazyLoss, int]] = []
+        # pending-loss ring: (LazyLoss, n_real_samples, post-dispatch step)
+        # awaiting resolution; the step rides along so the NaN guard can
+        # report exactly which step diverged
+        self._pending: list[tuple[LazyLoss, int, int]] = []
         self._window_t0: float | None = None
+        self._nan_guard = False  # set per-fit; checked at resolution
         setup_compile_cache()  # persistent XLA cache (env-gated no-op)
 
     # ------------------------------------------------------------------ state
@@ -359,6 +363,8 @@ class DataParallelTrainer:
 
     def _dispatch(self, state: TrainState, x, y, n_valid: int,
                   bucket: int) -> tuple[TrainState, LazyLoss]:
+        # chaos seam: transient step failure (disarmed cost: one attr test)
+        FAULTS.maybe_fire("train.step", state.step + 1)
         # Observability is gated on one flag check: when disabled, no span
         # object, no perf_counter read, no registry lock on this path.
         obs = _obs_enabled()
@@ -407,7 +413,7 @@ class DataParallelTrainer:
             METRICS.increment("train_step.iterations")
         if not self._pending:
             self._window_t0 = t0 if obs else time.perf_counter()
-        self._pending.append((lazy, n_valid))
+        self._pending.append((lazy, n_valid, state.step + 1))
         if len(self._pending) >= self.max_pending:
             self._resolve_pending()  # ring full: self-fence (bounded queue)
         return TrainState(params, tstate, state.step + 1, state.key), lazy
@@ -424,7 +430,7 @@ class DataParallelTrainer:
         # one fence suffices: device programs execute in dispatch order, so
         # the last loss being ready implies the whole window has executed
         entries[-1][0].block()
-        vals = [lazy.value() for lazy, _ in entries]
+        vals = [lazy.value() for lazy, _n, _s in entries]
         if obs:
             now = time.perf_counter()
             METRICS.observe_time("train_step.resolve_wait", now - wait0)
@@ -433,7 +439,7 @@ class DataParallelTrainer:
             t0 = self._window_t0
             if t0 is not None and now > t0:
                 window = now - t0
-                n_samples = sum(n for _, n in entries)
+                n_samples = sum(n for _, n, _s in entries)
                 METRICS.gauge("train_step.samples_per_sec", n_samples / window)
                 # amortized per-step execution time over the async window —
                 # the steady-state throughput histogram (dispatch times in
@@ -441,7 +447,22 @@ class DataParallelTrainer:
                 METRICS.observe_many(
                     "train_step.execute", [window / len(entries)] * len(entries))
         self._window_t0 = None
+        if self._nan_guard:
+            # divergence detection lives at the resolution point — the one
+            # place losses are host floats anyway, so the guard adds no sync
+            for (_lazy, _n, s), v in zip(entries, vals):
+                if not np.isfinite(v):
+                    METRICS.increment("resilience.nan_detected")
+                    raise DivergenceError(s, v)
         return vals
+
+    def abort(self) -> None:
+        """Drop the pending-loss ring without resolving — the supervisor's
+        retry path discards the in-flight window along with the state that
+        produced it, then resumes from the last checkpoint."""
+        self._pending.clear()
+        self._window_t0 = None
+        METRICS.increment("resilience.aborts")
 
     # ------------------------------------------------------------------ fit
     def _host_stream(self, data, epochs: int, skip: int, prefetch_size: int):
@@ -463,6 +484,8 @@ class DataParallelTrainer:
                         idx += 1
                         continue
                     idx += 1
+                    # chaos seam: input-pipeline failure mid-stream
+                    FAULTS.maybe_fire("data.next", idx)
                     x, y = ((b.features, b.labels)
                             if hasattr(b, "features") else (b[0], b[1]))
                     if not isinstance(x, jnp.ndarray):
@@ -479,7 +502,9 @@ class DataParallelTrainer:
             epochs: int = 1, *, checkpoint_manager=None,
             checkpoint_every: int = 0, resume: bool = True,
             async_dispatch: bool = True, resolve_every: int = 32,
-            prefetch_size: int = 2,
+            prefetch_size: int = 2, nan_guard: bool = False,
+            should_stop: Callable[[int], bool] | None = None,
+            extra_skip: int = 0,
             ) -> tuple[TrainState, list[float]]:
         """Run ``epochs`` passes over ``data``, counting steps from
         ``state.step`` — so a state restored from a checkpoint continues
@@ -497,26 +522,47 @@ class DataParallelTrainer:
         With ``checkpoint_manager`` set, auto-saves params + transform state
         + RNG key + data cursor every ``checkpoint_every`` steps (and at the
         end) — each save fences pending steps first; with ``resume``
-        (default) restores the latest checkpoint before training."""
+        (default) restores the latest checkpoint before training.
+
+        Supervisor hooks (all default-off; see ``resilience/``):
+        ``nan_guard`` raises :class:`~..resilience.faults.DivergenceError`
+        when a resolved loss is non-finite; ``should_stop(step)`` is polled
+        after every dispatch — True drains the ring, writes an emergency
+        checkpoint and returns (preemption handling); ``extra_skip`` drops
+        that many additional stream batches past the resume cursor (the
+        supervisor's divergence batch-window skip)."""
         n_known = len(data) if hasattr(data, "__len__") else -1
+        self._nan_guard = nan_guard
         with trace.span("trainer.fit", epochs=epochs, n_batches=n_known,
                         router=self.router):
             if checkpoint_manager is not None and resume \
                     and checkpoint_manager.latest_step() is not None:
-                state = self.restore(state, checkpoint_manager)
+                try:
+                    state = self.restore(state, checkpoint_manager)
+                except FileNotFoundError:
+                    # every on-disk checkpoint failed verification — train
+                    # from scratch rather than load corrupt state
+                    METRICS.increment("checkpoint.no_valid_restore")
             handles: list[LazyLoss] = []
             # steady state runs under the transfer guard: every host<->device
             # crossing in the loop must be an explicit device_put/device_get
             # (opt out via DL4J_TPU_TRANSFER_GUARD=0; see analysis.runtime)
             with hot_loop_guard():
                 for x, y, n_valid, bucket in self._host_stream(
-                        data, epochs, state.step, prefetch_size):
+                        data, epochs, state.step + extra_skip, prefetch_size):
                     state, lazy = self._dispatch(state, x, y, n_valid, bucket)
                     handles.append(lazy)
                     if not async_dispatch:
                         self._resolve_pending()  # sync reference path
                     elif resolve_every and len(self._pending) >= resolve_every:
                         self._resolve_pending()
+                    if should_stop is not None and should_stop(state.step):
+                        # preemption: drain in-flight steps, snapshot, leave
+                        self._resolve_pending()
+                        if checkpoint_manager is not None:
+                            self.checkpoint(state, checkpoint_manager)
+                        METRICS.increment("resilience.emergency_checkpoints")
+                        break
                     if (checkpoint_manager is not None and checkpoint_every > 0
                             and state.step % checkpoint_every == 0):
                         self.checkpoint(state, checkpoint_manager)

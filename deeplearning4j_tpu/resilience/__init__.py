@@ -1,0 +1,40 @@
+"""Resilience: fault injection + self-healing supervision (DESIGN.md §12).
+
+Three cooperating parts:
+
+- :mod:`.faults` — a deterministic, seedable chaos layer: named injection
+  sites across the training stack (worker death, slow worker, checkpoint
+  corruption, data-pipeline failure, transient step failure, simulated
+  preemption), armed via :func:`inject_faults` or ``DL4J_TPU_FAULTS``.
+- :mod:`.supervisor` — :class:`TrainingSupervisor`: bounded retry with
+  backoff + jitter, resume from the newest *valid* checkpoint, NaN/Inf
+  divergence rollback, SIGTERM/SIGINT emergency checkpointing.
+- hardening in the layers underneath (``parallel/checkpoint.py`` checksum
+  verification and restore fallback; ``parallel/scaleout.py`` job retry
+  budgets, poison-job quarantine, execution timeouts) — see those modules.
+"""
+
+from .faults import (
+    FAULTS,
+    DataIteratorFault,
+    DivergenceError,
+    FaultInjector,
+    FaultSpec,
+    InjectedFault,
+    PreemptionSignal,
+    TrainingPreempted,
+    TransientStepFault,
+    WorkerKilled,
+    corrupt_file,
+    inject_faults,
+    parse_fault_env,
+)
+from .supervisor import RetryPolicy, SupervisorReport, TrainingSupervisor
+
+__all__ = [
+    "FAULTS", "DataIteratorFault", "DivergenceError", "FaultInjector",
+    "FaultSpec", "InjectedFault", "PreemptionSignal", "RetryPolicy",
+    "SupervisorReport", "TrainingPreempted", "TrainingSupervisor",
+    "TransientStepFault", "WorkerKilled", "corrupt_file", "inject_faults",
+    "parse_fault_env",
+]

@@ -1,0 +1,306 @@
+"""Deterministic, seedable fault injection — the chaos half of the
+resilience subsystem (DESIGN.md §12).
+
+Every recovery path in this tree is testable in-process because the code
+under test calls into ONE global :data:`FAULTS` injector at named sites;
+when the injector is disarmed (the default) each site check is a single
+attribute test, so production hot loops pay nothing.
+
+Named sites (grep for ``FAULTS.maybe_fire`` / ``FAULTS.check``):
+
+=====================  =====================================================
+site                   effect when armed
+=====================  =====================================================
+``train.step``         :class:`TransientStepFault` raised before dispatching
+                       a train step (``DataParallelTrainer._dispatch``)
+``data.next``          :class:`DataIteratorFault` raised from the host batch
+                       stream (``DataParallelTrainer._host_stream``)
+``checkpoint.write``   checkpoint payload corrupted *after* checksums are
+                       recorded (``kind``: ``truncate`` | ``bitflip``) — the
+                       published checkpoint fails ``verify()``
+``preempt``            simulated preemption: the supervisor's ``should_stop``
+                       poll returns True (emergency checkpoint + resume)
+``scaleout.worker``    :class:`WorkerKilled` raised in the worker loop — the
+                       worker thread/process exits with its job still
+                       assigned (heartbeats stop; eviction must recover)
+``scaleout.worker.slow``  injected ``time.sleep(delay_s)`` before performing
+                       a job (straggler simulation)
+``scaleout.perform``   :class:`TransientStepFault` raised inside the job
+                       execution path (prompt failure -> requeue/quarantine)
+=====================  =====================================================
+
+Arming:
+
+- context manager (tests)::
+
+      with inject_faults(FaultSpec("train.step", at_step=5),
+                         FaultSpec("checkpoint.write", kind="bitflip",
+                                   at_step=2), seed=42):
+          ...
+
+- environment (subprocess workers, chaos CI):
+  ``DL4J_TPU_FAULTS="train.step:at=5;checkpoint.write:kind=bitflip,p=0.5"``
+  with ``DL4J_TPU_FAULTS_SEED=<int>``.  Parsed lazily on the first site
+  check, so worker processes spawned with the variable inherit the plan.
+
+Determinism: probability draws use a per-site ``random.Random`` seeded from
+``(seed, site)`` and a per-site call counter — the same plan + seed fires
+at the same call indices regardless of wall clock or interleaving of OTHER
+sites.  Every fire increments ``faults.injected.<site>`` in the metrics
+registry, so a chaos run's injected-fault schedule is visible next to the
+recovery counters it should have triggered.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from ..observability import METRICS
+
+# --------------------------------------------------------------------------- errors
+
+class InjectedFault(RuntimeError):
+    """Base class for every exception the fault layer raises."""
+
+
+class TransientStepFault(InjectedFault):
+    """A single training step / job execution failed (retryable)."""
+
+
+class DataIteratorFault(InjectedFault):
+    """The input pipeline raised mid-stream (retryable)."""
+
+
+class WorkerKilled(InjectedFault):
+    """A scaleout worker died silently (no failure report — heartbeats
+    just stop).  Raised inside the worker loop; never seen by the master."""
+
+
+class PreemptionSignal(InjectedFault):
+    """Simulated SIGTERM-style preemption notice."""
+
+
+class DivergenceError(RuntimeError):
+    """NaN/Inf loss detected at the async resolution point.
+
+    ``step`` is the post-dispatch step number of the FIRST non-finite loss
+    in the resolved window — the supervisor uses it to size the batch
+    window to skip after rolling back.
+    """
+
+    def __init__(self, step: int, value: float):
+        super().__init__(f"non-finite loss {value!r} at step {step}")
+        self.step = step
+        self.value = value
+
+
+class TrainingPreempted(RuntimeError):
+    """A real SIGTERM/SIGINT arrived: the emergency checkpoint was written
+    and the supervisor is handing control back so the process can exit."""
+
+    def __init__(self, step: int):
+        super().__init__(f"preempted at step {step} (emergency checkpoint saved)")
+        self.step = step
+
+
+#: default exception per site for ``maybe_fire``
+_SITE_EXC: dict[str, type[InjectedFault]] = {
+    "train.step": TransientStepFault,
+    "data.next": DataIteratorFault,
+    "preempt": PreemptionSignal,
+    "scaleout.worker": WorkerKilled,
+    "scaleout.perform": TransientStepFault,
+}
+
+
+# --------------------------------------------------------------------------- specs
+
+@dataclass
+class FaultSpec:
+    """One site's trigger: fire at an exact step/call index, or with a
+    seeded per-call probability — never both silently (``at_step`` wins).
+
+    ``max_fires`` bounds total fires (default 1: faults are *transient*
+    by default, so a retried path does not re-fail forever); ``0`` means
+    unbounded.  ``kind`` is a site-specific payload (checkpoint corruption
+    flavor); ``delay_s`` is the injected sleep for slow-worker sites.
+    """
+
+    site: str
+    probability: float = 0.0
+    at_step: int | None = None
+    kind: str = "bitflip"
+    max_fires: int = 1
+    delay_s: float = 0.05
+
+
+@dataclass
+class _SiteState:
+    spec: FaultSpec
+    calls: int = 0
+    fires: int = 0
+    rng: random.Random = field(default_factory=random.Random)
+
+
+class FaultInjector:
+    """The process-global chaos switchboard (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: dict[str, _SiteState] = {}
+        self._armed = False
+        self._env_checked = False
+
+    # ------------------------------------------------------------- arming
+    def arm(self, specs, seed: int = 0) -> None:
+        with self._lock:
+            self._sites = {}
+            for spec in specs:
+                st = _SiteState(spec=spec)
+                st.rng.seed(f"{seed}:{spec.site}")
+                self._sites[spec.site] = st
+            self._armed = bool(self._sites)
+            self._env_checked = True
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._sites = {}
+            self._armed = False
+            # re-allow env arming for the next explicit opt-in
+            self._env_checked = False
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def _arm_from_env_locked(self) -> None:
+        self._env_checked = True
+        raw = os.environ.get("DL4J_TPU_FAULTS", "").strip()
+        if not raw:
+            return
+        seed = int(os.environ.get("DL4J_TPU_FAULTS_SEED", "0"))
+        specs = parse_fault_env(raw)
+        for spec in specs:
+            st = _SiteState(spec=spec)
+            st.rng.seed(f"{seed}:{spec.site}")
+            self._sites[spec.site] = st
+        self._armed = bool(self._sites)
+
+    # ------------------------------------------------------------- checks
+    def check(self, site: str, step: int | None = None) -> FaultSpec | None:
+        """Non-raising trigger test: returns the :class:`FaultSpec` when
+        the site fires this call, else None.  The disarmed fast path is a
+        single attribute test."""
+        if not self._armed and self._env_checked:
+            return None
+        with self._lock:
+            if not self._env_checked:
+                self._arm_from_env_locked()
+            st = self._sites.get(site)
+            if st is None:
+                return None
+            st.calls += 1
+            if st.spec.max_fires and st.fires >= st.spec.max_fires:
+                return None
+            if st.spec.at_step is not None:
+                index = step if step is not None else st.calls
+                fired = index == st.spec.at_step
+            else:
+                fired = st.rng.random() < st.spec.probability
+            if not fired:
+                return None
+            st.fires += 1
+        METRICS.increment(f"faults.injected.{site}")
+        return st.spec
+
+    def maybe_fire(self, site: str, step: int | None = None) -> None:
+        """Raising trigger test: raises the site's mapped
+        :class:`InjectedFault` subclass when the site fires."""
+        spec = self.check(site, step)
+        if spec is not None:
+            exc = _SITE_EXC.get(site, InjectedFault)
+            raise exc(f"injected fault at site {site!r}"
+                      + (f" (step {step})" if step is not None else ""))
+
+    def fire_count(self, site: str) -> int:
+        with self._lock:
+            st = self._sites.get(site)
+            return st.fires if st is not None else 0
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return {s: st.fires for s, st in self._sites.items()}
+
+
+def parse_fault_env(raw: str) -> list[FaultSpec]:
+    """``"site:k=v,k=v;site2:k=v"`` -> specs.
+
+    Keys: ``p``/``prob``/``probability``, ``at``/``at_step``, ``kind``,
+    ``max``/``max_fires``, ``delay``/``delay_s``.  A site with no keys
+    (``"preempt"``) fires once at probability 1.
+    """
+    specs = []
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, kvs = part.partition(":")
+        spec = FaultSpec(site=site.strip())
+        if not kvs.strip():
+            spec.probability = 1.0
+        for kv in kvs.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            k, v = k.strip(), v.strip()
+            if k in ("p", "prob", "probability"):
+                spec.probability = float(v)
+            elif k in ("at", "at_step"):
+                spec.at_step = int(v)
+            elif k == "kind":
+                spec.kind = v
+            elif k in ("max", "max_fires"):
+                spec.max_fires = int(v)
+            elif k in ("delay", "delay_s"):
+                spec.delay_s = float(v)
+            else:
+                raise ValueError(f"unknown fault spec key {k!r} in {part!r}")
+        specs.append(spec)
+    return specs
+
+
+#: the process-global injector every instrumented site consults
+FAULTS = FaultInjector()
+
+
+@contextmanager
+def inject_faults(*specs: FaultSpec, seed: int = 0):
+    """Arm :data:`FAULTS` with ``specs`` for the duration of the block."""
+    FAULTS.arm(specs, seed=seed)
+    try:
+        yield FAULTS
+    finally:
+        FAULTS.disarm()
+
+
+def corrupt_file(path, kind: str = "bitflip") -> None:
+    """Damage a file in place — the checkpoint-corruption payloads.
+
+    ``truncate`` keeps the first half (torn write); ``bitflip`` flips one
+    byte in the middle (silent medium corruption).  Both must be caught by
+    the checksum ``verify()`` pass, never by a lucky parse error.
+    """
+    data = path.read_bytes()
+    if kind == "truncate":
+        path.write_bytes(data[: max(1, len(data) // 2)])
+    elif kind == "bitflip":
+        mid = len(data) // 2
+        flipped = bytes([data[mid] ^ 0xFF])
+        path.write_bytes(data[:mid] + flipped + data[mid + 1:])
+    else:
+        raise ValueError(f"unknown corruption kind {kind!r}")

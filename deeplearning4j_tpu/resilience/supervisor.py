@@ -1,0 +1,240 @@
+"""Self-healing training supervisor (DESIGN.md §12).
+
+Wraps a :class:`~..parallel.trainer.DataParallelTrainer` fit (and, via
+:meth:`TrainingSupervisor.supervise`, any run-shaped callable such as
+``Driver.run`` or ``DistributedRunner.run``) with the recovery policy the
+scaleout layer's heartbeat eviction stops short of:
+
+- **bounded retry** with exponential backoff + jitter
+  (:class:`RetryPolicy`) — a transient step failure, a dying data
+  pipeline, or a crashed attempt resumes from the newest *valid*
+  checkpoint (params + transform state + RNG key + data cursor), so a
+  retried run re-joins the uninterrupted trajectory bitwise;
+- **NaN/Inf divergence guard**: the trainer detects a non-finite loss at
+  the async resolution point and raises
+  :class:`~.faults.DivergenceError`; the supervisor rolls back to the
+  last checkpoint and (optionally) skips the offending batch window
+  instead of silently training on garbage;
+- **preemption handling**: SIGTERM/SIGINT set a flag the fit loop polls
+  between steps; the trainer drains its pending ring, writes an
+  emergency checkpoint, and returns — the supervisor then either resumes
+  (simulated/injected preemption) or raises
+  :class:`~.faults.TrainingPreempted` so the process can exit having
+  lost nothing.
+
+Every recovery event is counted in the metrics registry
+(``resilience.retries``, ``resilience.rollbacks``,
+``resilience.preemptions``, ``resilience.emergency_checkpoints``,
+``resilience.gave_up``) and summarized in :class:`SupervisorReport`.
+
+This module deliberately imports nothing from ``parallel/`` — the trainer
+and checkpoint manager arrive as arguments — so the dependency arrow runs
+one way: the training stack calls INTO resilience, never the reverse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import signal
+import threading
+import time
+from typing import Any, Callable
+
+from ..observability import METRICS, trace
+from .faults import FAULTS, DivergenceError, TrainingPreempted
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded retry with exponential backoff + jitter.
+
+    ``max_attempts`` bounds a *failure streak*: a successful attempt (or a
+    divergence rollback, which has its own ``max_rollbacks`` budget)
+    resets the streak.  ``retry_on`` is the exception tuple that counts
+    as retryable — everything else propagates immediately.
+    """
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 5.0
+    jitter: float = 0.1
+    retry_on: tuple = (Exception,)
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.backoff_max_s,
+                   self.backoff_base_s * self.backoff_factor ** max(0, attempt - 1))
+        return base * (1.0 + self.jitter * rng.random())
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    """What happened across one supervised run (also mirrored to METRICS)."""
+
+    attempts: int = 0              # fit attempts started
+    retries: int = 0               # attempts that ended in a retryable failure
+    rollbacks: int = 0             # divergence rollbacks
+    preemptions: int = 0           # injected/simulated preemptions resumed
+    emergency_checkpoints: int = 0
+    skipped_steps: int = 0         # batch-window steps skipped after rollback
+    resumed_from: list = dataclasses.field(default_factory=list)
+    # step -> loss for every step a successful attempt resolved; steps
+    # whose attempt aborted mid-window are absent (their losses died with
+    # the pending ring), so consumers must align by step, not position
+    losses_by_step: dict = dataclasses.field(default_factory=dict)
+
+
+class TrainingSupervisor:
+    """Retry / rollback / preemption supervisor around a trainer fit.
+
+    ``checkpoint_manager`` is required for :meth:`fit` (resume is the
+    whole recovery mechanism) and unused by :meth:`supervise`.
+    ``install_signal_handlers`` hooks SIGTERM/SIGINT for
+    emergency-checkpoint-then-exit; it is skipped automatically off the
+    main thread (the ``signal`` module's constraint).
+    """
+
+    def __init__(self, checkpoint_manager=None, policy: RetryPolicy | None = None,
+                 *, nan_guard: bool = True, skip_window_on_divergence: bool = True,
+                 max_rollbacks: int = 3, install_signal_handlers: bool = True,
+                 seed: int = 0):
+        self.manager = checkpoint_manager
+        self.policy = policy or RetryPolicy()
+        self.nan_guard = nan_guard
+        self.skip_window_on_divergence = skip_window_on_divergence
+        self.max_rollbacks = max_rollbacks
+        self.install_signal_handlers = install_signal_handlers
+        self.report = SupervisorReport()
+        self._rng = random.Random(seed)
+        self._preempt_requested = False
+        self._injected_preempt = False
+        self._old_handlers: dict[int, Any] = {}
+
+    # ------------------------------------------------------------- signals
+    def _handle_signal(self, signum, frame) -> None:
+        self._preempt_requested = True
+        METRICS.increment("resilience.signals")
+
+    def _install_signals(self) -> None:
+        if (not self.install_signal_handlers
+                or threading.current_thread() is not threading.main_thread()):
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._old_handlers[sig] = signal.signal(sig, self._handle_signal)
+
+    def _restore_signals(self) -> None:
+        for sig, old in self._old_handlers.items():
+            signal.signal(sig, old)
+        self._old_handlers.clear()
+
+    def _should_stop(self, step: int) -> bool:
+        """The fit loop's per-step preemption poll: real signals and the
+        injected ``preempt`` fault site both land here."""
+        if self._preempt_requested:
+            return True
+        if FAULTS.check("preempt", step) is not None:
+            self._injected_preempt = True
+            return True
+        return False
+
+    # ------------------------------------------------------------- generic
+    def supervise(self, fn: Callable, *args, **kwargs):
+        """Bounded-retry wrapper for run-shaped callables that own their
+        own resume semantics (``Driver.run``, ``DistributedRunner.run``)."""
+        attempt = 0
+        while True:
+            self.report.attempts += 1
+            try:
+                return fn(*args, **kwargs)
+            except self.policy.retry_on:
+                attempt += 1
+                self.report.retries += 1
+                METRICS.increment("resilience.retries")
+                if attempt >= self.policy.max_attempts:
+                    METRICS.increment("resilience.gave_up")
+                    raise
+                time.sleep(self.policy.backoff(attempt, self._rng))
+
+    # ------------------------------------------------------------- fit
+    def fit(self, trainer, params, data, *, epochs: int = 1,
+            checkpoint_every: int = 1, key=None,
+            **fit_kwargs) -> tuple[Any, list[float]]:
+        """Supervised ``trainer.fit``: run to completion through faults.
+
+        ``data`` must be re-iterable, or a zero-arg callable returning a
+        fresh iterable per attempt (one-shot generators cannot be
+        replayed after a mid-stream failure).  Returns the final state
+        and the per-step losses keyed by step (each step's loss appears
+        once even when a window was re-run after a rollback).
+        """
+        if self.manager is None:
+            raise ValueError("TrainingSupervisor.fit requires a checkpoint_manager")
+        data_factory = data if callable(data) else (lambda: data)
+        by_step: dict[int, float] = {}
+        streak = 0
+        rollbacks = 0
+        extra_skip = 0
+        self._preempt_requested = False
+        self._install_signals()
+        try:
+            with trace.span("resilience.supervised_fit", epochs=epochs):
+                while True:
+                    self._injected_preempt = False
+                    self.report.attempts += 1
+                    resumed = self.manager.latest_valid_step()
+                    if resumed is not None:
+                        self.report.resumed_from.append(resumed)
+                    template = trainer.init_state(params, key=key)
+                    try:
+                        state, losses = trainer.fit(
+                            template, data_factory(), epochs=epochs,
+                            checkpoint_manager=self.manager,
+                            checkpoint_every=checkpoint_every, resume=True,
+                            nan_guard=self.nan_guard,
+                            should_stop=self._should_stop,
+                            extra_skip=extra_skip, **fit_kwargs)
+                    except DivergenceError as e:
+                        trainer.abort()
+                        rollbacks += 1
+                        self.report.rollbacks += 1
+                        METRICS.increment("resilience.rollbacks")
+                        if rollbacks > self.max_rollbacks:
+                            METRICS.increment("resilience.gave_up")
+                            raise
+                        if self.skip_window_on_divergence:
+                            # skip the batch window (target, e.step]: the
+                            # restore covers steps <= target, extra_skip
+                            # drops the batches that produced the NaN
+                            target = self.manager.latest_valid_step() or 0
+                            window = max(1, e.step - target)
+                            extra_skip += window
+                            self.report.skipped_steps += window
+                        continue
+                    except self.policy.retry_on:
+                        trainer.abort()
+                        streak += 1
+                        self.report.retries += 1
+                        METRICS.increment("resilience.retries")
+                        if streak >= self.policy.max_attempts:
+                            METRICS.increment("resilience.gave_up")
+                            raise
+                        time.sleep(self.policy.backoff(streak, self._rng))
+                        continue
+                    streak = 0
+                    for i, loss in enumerate(losses):
+                        by_step[state.step - len(losses) + 1 + i] = loss
+                    self.report.losses_by_step = dict(by_step)
+                    if self._injected_preempt:
+                        self.report.preemptions += 1
+                        self.report.emergency_checkpoints += 1
+                        METRICS.increment("resilience.preemptions")
+                        continue  # resume from the emergency checkpoint
+                    if self._preempt_requested:
+                        METRICS.increment("resilience.preemptions")
+                        exc = TrainingPreempted(state.step)
+                        exc.state = state
+                        raise exc
+                    return state, [by_step[s] for s in sorted(by_step)]
+        finally:
+            self._restore_signals()

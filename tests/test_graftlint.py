@@ -387,6 +387,38 @@ def test_hot02_quiet_on_host_only_loops():
     assert lint(src, only="HOT02") == []
 
 
+# --------------------------------------------------------------------------- EXC01
+
+EXC01_BAD = """
+    def retry(fn, attempts=3):
+        for _ in range(attempts):
+            try:
+                return fn()
+            except:
+                continue
+"""
+
+
+def test_exc01_fires_on_bare_except():
+    findings = [f for f in lint(EXC01_BAD) if f.rule == "EXC01"]
+    assert len(findings) == 1
+    assert "SystemExit" in findings[0].message
+
+
+def test_exc01_quiet_on_typed_handlers():
+    src = """
+        def retry(fn, attempts=3, retry_on=(Exception,)):
+            for _ in range(attempts):
+                try:
+                    return fn()
+                except retry_on:
+                    continue
+                except Exception:
+                    raise
+    """
+    assert lint(src, only="EXC01") == []
+
+
 # --------------------------------------------------------------------------- suppressions
 
 def test_same_line_pragma_suppresses_one_rule():

@@ -1,0 +1,544 @@
+"""Resilience subsystem: fault injection, self-healing supervision,
+checkpoint integrity, and scaleout hardening (DESIGN.md §12).
+
+The acceptance chain for this tier lives in
+``test_supervised_chaos_parity``: transient step failure + corrupted
+checkpoint write + data-pipeline failure in ONE run, and the supervisor
+still finishes with the exact parameters of a fault-free run — resuming
+from the newest checkpoint that passes checksum verification, never from
+the corrupted one.  Everything else here pins the parts: deterministic
+injection, checksum verify/fallback, scalar-leaf restore, NaN rollback
+with batch-window skip, preemption resume, job retry/quarantine, run
+timeouts, and the FileModelSaver tmp-file race.
+"""
+
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel.checkpoint import (
+    CheckpointCorruptError, CheckpointManager)
+from deeplearning4j_tpu.parallel.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.parallel.scaleout import (
+    CollectionJobIterator, DistributedRunner, FileModelSaver, ScaleoutTimeout,
+    StateTracker)
+from deeplearning4j_tpu.parallel.trainer import DataParallelTrainer
+from deeplearning4j_tpu.resilience import (
+    FAULTS, DataIteratorFault, FaultInjector, FaultSpec, RetryPolicy,
+    TrainingSupervisor, TransientStepFault, corrupt_file, inject_faults,
+    parse_fault_env)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+# --------------------------------------------------------------------------- injector
+
+def test_injector_probability_is_deterministic_per_seed():
+    def fire_indices(seed):
+        inj = FaultInjector()
+        inj.arm([FaultSpec("train.step", probability=0.3, max_fires=0)],
+                seed=seed)
+        return [i for i in range(100) if inj.check("train.step") is not None]
+
+    a, b = fire_indices(7), fire_indices(7)
+    assert a == b and a                      # same plan + seed -> same schedule
+    assert fire_indices(8) != a              # seed actually matters
+
+
+def test_injector_at_step_and_max_fires():
+    inj = FaultInjector()
+    inj.arm([FaultSpec("train.step", at_step=3)], seed=0)
+    fired = [s for s in range(1, 10) if inj.check("train.step", s) is not None]
+    assert fired == [3]                      # max_fires=1: transient by default
+    assert inj.fire_count("train.step") == 1
+
+
+def test_maybe_fire_raises_mapped_exception():
+    with inject_faults(FaultSpec("train.step", at_step=1)):
+        with pytest.raises(TransientStepFault):
+            FAULTS.maybe_fire("train.step", 1)
+    assert FAULTS.check("train.step", 1) is None     # disarmed on exit
+
+
+def test_parse_fault_env():
+    specs = parse_fault_env(
+        "train.step:at=5;checkpoint.write:kind=truncate,p=0.5,max=2;preempt")
+    by_site = {s.site: s for s in specs}
+    assert by_site["train.step"].at_step == 5
+    assert by_site["checkpoint.write"].kind == "truncate"
+    assert by_site["checkpoint.write"].probability == 0.5
+    assert by_site["checkpoint.write"].max_fires == 2
+    assert by_site["preempt"].probability == 1.0     # bare site fires
+    with pytest.raises(ValueError):
+        parse_fault_env("train.step:bogus=1")
+
+
+def test_env_arming_is_lazy(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FAULTS", "train.step:at=2")
+    monkeypatch.setenv("DL4J_TPU_FAULTS_SEED", "9")
+    FAULTS.disarm()                          # re-allow env pickup
+    assert FAULTS.check("train.step", 1) is None
+    assert FAULTS.check("train.step", 2) is not None
+
+
+# --------------------------------------------------------------------------- checkpoint integrity
+
+def _save_steps(mgr, steps):
+    for s in steps:
+        mgr.save(s, {"w": jnp.full(4, float(s))})
+
+
+def test_checksum_verify_detects_corruption(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=10)
+    _save_steps(mgr, [1, 2, 3])
+    assert all(mgr.verify(s) for s in (1, 2, 3))
+    for kind in ("truncate", "bitflip"):
+        corrupt_file(tmp_path / "ckpt_0000000003" / "params.npz", kind)
+        assert not mgr.verify(3)
+    assert mgr.latest_valid_step() == 2
+
+
+def test_restore_falls_back_to_newest_valid(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=10)
+    _save_steps(mgr, [1, 2, 3])
+    corrupt_file(tmp_path / "ckpt_0000000003" / "params.npz", "bitflip")
+    before = METRICS.snapshot()["counters"].get("checkpoint.corrupt_detected", 0)
+    with pytest.warns(UserWarning, match="failed checksum"):
+        out = mgr.restore({"w": jnp.zeros(4)})
+    assert out["step"] == 2
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]), np.full(4, 2.0))
+    after = METRICS.snapshot()["counters"]["checkpoint.corrupt_detected"]
+    assert after == before + 1
+
+
+def test_explicit_corrupt_step_raises(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=10)
+    _save_steps(mgr, [1])
+    corrupt_file(tmp_path / "ckpt_0000000001" / "params.npz", "truncate")
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore({"w": jnp.zeros(4)}, step=1)
+    with pytest.raises(FileNotFoundError, match="all corrupt"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mgr.restore({"w": jnp.zeros(4)})
+
+
+def test_checkpoint_write_fault_site_corrupts_published_payload(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=10)
+    with inject_faults(FaultSpec("checkpoint.write", at_step=2, kind="bitflip")):
+        _save_steps(mgr, [1, 2])
+    assert mgr.verify(1) and not mgr.verify(2)
+    assert mgr.latest_valid_step() == 1
+
+
+def test_restore_like_scalar_and_bool_leaves(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=3)
+    params = {"w": jnp.ones(4), "count": 3, "rate": 0.5, "flag": True}
+    mgr.save(1, params)
+    out = mgr.restore({"w": jnp.zeros(4), "count": 0, "rate": 0.0,
+                       "flag": False})
+    assert out["params"]["count"] == 3 and type(out["params"]["count"]) is int
+    assert out["params"]["rate"] == 0.5
+    assert out["params"]["flag"] is True
+
+
+def test_restore_warns_on_unused_checkpoint_keys(tmp_path):
+    mgr = CheckpointManager(tmp_path, keep=3)
+    mgr.save(1, {"w": jnp.ones(4), "extra": jnp.zeros(2)})
+    with pytest.warns(UserWarning, match="absent from the restore template"):
+        out = mgr.restore({"w": jnp.zeros(4)})
+    assert "extra" not in out["params"]
+    assert METRICS.snapshot()["counters"]["checkpoint.unused_keys"] == 1
+
+
+# --------------------------------------------------------------------------- supervised training
+
+def _toy_problem():
+    w_true = jnp.asarray([1.0, -2.0, 0.5])
+    x = jax.random.normal(jax.random.key(3), (64, 3))
+    y = x @ w_true
+    params = {"w": jnp.zeros(3)}
+
+    def loss_fn(p, xb, yb, key=None):
+        return jnp.mean(((xb @ p["w"]) - yb) ** 2)
+
+    return params, loss_fn, x, y
+
+
+class _Batch:
+    def __init__(self, x, y):
+        self.features, self.labels = x, y
+
+
+def _batches(x, y, n=8, bs=8):
+    return [_Batch(x[i * bs:(i + 1) * bs], y[i * bs:(i + 1) * bs])
+            for i in range(n)]
+
+
+def _new_trainer(loss_fn):
+    mesh = make_mesh(MeshSpec(dp=8), devices=jax.devices()[:8])
+    return DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
+                                                T.sgd_lr(5e-2)), mesh=mesh)
+
+
+def test_supervised_chaos_parity(tmp_path):
+    """The acceptance chain: transient step failure + corrupted checkpoint
+    write + data-pipeline failure in one run — the supervisor completes,
+    resumes from the newest VALID checkpoint (the corrupted one is
+    detected by checksum and skipped), and the final parameters are
+    bitwise identical to a fault-free run."""
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+
+    t_ref = _new_trainer(loss_fn)
+    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1)
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    with inject_faults(FaultSpec("checkpoint.write", at_step=4, kind="bitflip"),
+                       FaultSpec("train.step", at_step=5),
+                       FaultSpec("data.next", at_step=7), seed=11):
+        sup = TrainingSupervisor(
+            mgr, RetryPolicy(max_attempts=4, backoff_base_s=0.01))
+        t = _new_trainer(loss_fn)
+        state, losses = sup.fit(t, params, data, epochs=1, checkpoint_every=2)
+
+    assert state.step == s_ref.step
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # losses resolved after the last resume match the reference exactly
+    for step_loss, ref_loss in zip(losses[::-1], ref_losses[::-1]):
+        assert step_loss == ref_loss
+    assert sup.report.retries >= 1
+    counters = METRICS.snapshot()["counters"]
+    assert counters["checkpoint.corrupt_detected"] >= 1
+    assert counters["resilience.retries"] >= 1
+    assert counters["faults.injected.train.step"] == 1
+    # recovery events are scrapeable, not just in-process
+    prom = METRICS.to_prometheus()
+    assert "resilience" in prom and "corrupt_detected" in prom
+
+
+def test_nan_guard_rolls_back_and_skips_window(tmp_path):
+    """A batch with non-finite labels diverges the loss at step 5: the
+    supervisor rolls back to the last checkpoint and skips the poisoned
+    batch window, finishing the remaining stream."""
+    params, loss_fn, x, y = _toy_problem()
+    y = np.array(y)                          # writable host copy
+    y[4 * 8:5 * 8] = np.nan                  # batch index 4 -> step 5
+    data = _batches(x, jnp.asarray(y))
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    sup = TrainingSupervisor(mgr, RetryPolicy(max_attempts=3,
+                                              backoff_base_s=0.01))
+    t = _new_trainer(loss_fn)
+    state, losses = sup.fit(t, params, data, epochs=1, checkpoint_every=1)
+
+    assert sup.report.rollbacks == 1
+    assert sup.report.skipped_steps == 1
+    # 8 batches, one skipped -> 7 steps, and nothing non-finite survived
+    assert state.step == 7
+    assert all(np.isfinite(v) for v in losses)
+    counters = METRICS.snapshot()["counters"]
+    assert counters["resilience.nan_detected"] == 1
+    assert counters["resilience.rollbacks"] == 1
+
+
+def test_nan_guard_gives_up_after_max_rollbacks(tmp_path):
+    params, loss_fn, x, y = _toy_problem()
+    y = np.array(y)                          # writable host copy
+    y[:] = np.nan                            # every batch diverges
+    data = _batches(x, jnp.asarray(y))
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    sup = TrainingSupervisor(mgr, max_rollbacks=2)
+    t = _new_trainer(loss_fn)
+    from deeplearning4j_tpu.resilience import DivergenceError
+    with pytest.raises(DivergenceError):
+        sup.fit(t, params, data, epochs=1, checkpoint_every=1)
+    assert sup.report.rollbacks == 3         # budget exhausted
+    assert METRICS.snapshot()["counters"]["resilience.gave_up"] == 1
+
+
+def test_injected_preemption_checkpoints_and_resumes(tmp_path):
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+
+    t_ref = _new_trainer(loss_fn)
+    s_ref, _ = t_ref.fit(t_ref.init_state(params), data, epochs=1)
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    with inject_faults(FaultSpec("preempt", at_step=3), seed=0):
+        sup = TrainingSupervisor(mgr)
+        t = _new_trainer(loss_fn)
+        state, losses = sup.fit(t, params, data, epochs=1, checkpoint_every=4)
+
+    assert sup.report.preemptions == 1
+    assert 3 in sup.report.resumed_from      # emergency checkpoint at step 3
+    assert state.step == s_ref.step
+    for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    counters = METRICS.snapshot()["counters"]
+    assert counters["resilience.emergency_checkpoints"] >= 1
+
+
+def test_fit_trains_from_scratch_when_all_checkpoints_corrupt(tmp_path):
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    t = _new_trainer(loss_fn)
+    t.fit(t.init_state(params), data, epochs=1, checkpoint_manager=mgr)
+    for s in mgr.all_steps():
+        corrupt_file(tmp_path / "ckpt" / f"ckpt_{s:010d}" / "params.npz",
+                     "truncate")
+    t2 = _new_trainer(loss_fn)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, losses = t2.fit(t2.init_state(params), data, epochs=1,
+                               checkpoint_manager=mgr, resume=True)
+    assert state.step == len(data)           # full run, not a corrupt resume
+    assert METRICS.snapshot()["counters"]["checkpoint.no_valid_restore"] == 1
+
+
+def test_data_iterator_fault_is_retryable(tmp_path):
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    with inject_faults(FaultSpec("data.next", at_step=5), seed=0):
+        sup = TrainingSupervisor(mgr, RetryPolicy(max_attempts=3,
+                                                  backoff_base_s=0.01))
+        t = _new_trainer(loss_fn)
+        state, _ = sup.fit(t, params, data, epochs=1, checkpoint_every=2)
+    assert state.step == len(data)
+    assert sup.report.retries == 1
+
+
+def test_supervise_generic_retry_and_give_up():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientStepFault("boom")
+        return "ok"
+
+    sup = TrainingSupervisor(policy=RetryPolicy(max_attempts=3,
+                                                backoff_base_s=0.0))
+    assert sup.supervise(flaky) == "ok"
+    assert calls["n"] == 3
+
+    def doomed():
+        raise TransientStepFault("always")
+
+    sup2 = TrainingSupervisor(policy=RetryPolicy(max_attempts=2,
+                                                 backoff_base_s=0.0))
+    with pytest.raises(TransientStepFault):
+        sup2.supervise(doomed)
+
+
+# --------------------------------------------------------------------------- scaleout hardening
+
+class _DeltaPerformer:
+    """Order-free: final model == init + sum(job deltas) iff each job ran
+    at least once and results were aggregated exactly once each."""
+
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def perform(self, job):
+        current = self.tracker.get_current()
+        base = np.zeros(4) if current is None else np.asarray(current)
+        job.result = base + np.full(4, float(job.work))
+
+    def update(self, *args):
+        pass
+
+
+def _run_jobs(performer_factory, jobs, n_workers, **kw):
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(4))
+    runner = DistributedRunner(
+        CollectionJobIterator(jobs), performer_factory,
+        n_workers=n_workers, tracker=tracker, **kw)
+    result = runner.run(max_wall_s=60.0)
+    return np.asarray(result), tracker, runner
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_injected_worker_kill_recovers_with_parity():
+    """Chaos flavor of the elastic test: the injected ``scaleout.worker``
+    site kills one worker silently (no failure report — heartbeats stop),
+    eviction re-routes its job, and the survivor finishes with the same
+    model as the single-worker fault-free run (the dead worker never
+    reports an update, so no two-worker wave ever averages)."""
+    jobs = [1.0, 2.0, 3.0, 4.0, 5.0]
+    ref, _, _ = _run_jobs(_DeltaPerformer, jobs, n_workers=1)
+    with inject_faults(FaultSpec("scaleout.worker", at_step=1), seed=0):
+        got, tracker, _ = _run_jobs(_DeltaPerformer, jobs, n_workers=2,
+                                    eviction_timeout_s=0.5)
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+    assert tracker.is_done()
+    counters = METRICS.snapshot()["counters"]
+    assert counters["faults.injected.scaleout.worker"] == 1
+    assert counters["scaleout.workers_evicted"] >= 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_transient_perform_failure_requeues_promptly():
+    jobs = [1.0, 2.0, 3.0]
+    ref, _, _ = _run_jobs(_DeltaPerformer, jobs, n_workers=1)
+    with inject_faults(FaultSpec("scaleout.perform", at_step=1), seed=0):
+        got, tracker, _ = _run_jobs(_DeltaPerformer, jobs, n_workers=2)
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+    counters = METRICS.snapshot()["counters"]
+    assert counters["scaleout.job_failures"] == 1
+    assert counters["scaleout.jobs_requeued"] == 1
+    assert not tracker.quarantined()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_poison_job_is_quarantined_not_fatal():
+    """A job that fails every attempt exhausts its retry budget and is
+    quarantined; every worker it kills is respawned (opt-in budget) and
+    each healthy job still executes exactly once."""
+    executed = []
+    exec_lock = threading.Lock()
+
+    class Poisoned(_DeltaPerformer):
+        def perform(self, job):
+            if float(job.work) == 3.0:
+                raise RuntimeError("poison")
+            super().perform(job)
+            with exec_lock:
+                executed.append(float(job.work))
+
+    jobs = [1.0, 2.0, 3.0, 4.0]
+    _, tracker, _ = _run_jobs(Poisoned, jobs, n_workers=2,
+                              max_job_attempts=2, max_respawns=4)
+    assert tracker.is_done()
+    assert sorted(executed) == [1.0, 2.0, 4.0]
+    quarantined = tracker.quarantined()
+    assert [float(j.work) for j in quarantined] == [3.0]
+    assert quarantined[0].attempts == 2
+    assert "poison" in quarantined[0].last_error
+    counters = METRICS.snapshot()["counters"]
+    assert counters["scaleout.jobs_quarantined"] == 1
+    assert counters["scaleout.workers_respawned"] >= 1
+
+
+def test_run_timeout_raises_with_partial():
+    class Slow(_DeltaPerformer):
+        def perform(self, job):
+            time.sleep(1.0)
+            super().perform(job)
+
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(4))
+    runner = DistributedRunner(CollectionJobIterator([1.0, 2.0, 3.0]), Slow,
+                               n_workers=1, tracker=tracker)
+    with pytest.raises(ScaleoutTimeout) as ei:
+        runner.run(max_wall_s=0.3)
+    assert ei.value.partial is not None
+    assert METRICS.snapshot()["counters"]["scaleout.run_timeouts"] == 1
+
+    tracker2 = StateTracker()
+    tracker2.set_current(np.zeros(4))
+    runner2 = DistributedRunner(CollectionJobIterator([1.0]), Slow,
+                                n_workers=1, tracker=tracker2,
+                                on_timeout="return")
+    out = runner2.run(max_wall_s=0.2)        # opt-in best-effort return
+    assert out is not None
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_job_timeout_reroutes_wedged_worker():
+    """With ``job_timeout_s`` armed, a worker stuck in perform is treated
+    like a dead one: removed, its job re-routed, the run completes."""
+    stuck = {"done": False}
+
+    class WedgeOnce(_DeltaPerformer):
+        _lock = threading.Lock()
+
+        def perform(self, job):
+            with WedgeOnce._lock:
+                first = not stuck["done"]
+                stuck["done"] = True
+            if first:
+                time.sleep(3.0)              # well past job_timeout_s
+                return                        # result discarded: worker was removed
+            super().perform(job)
+
+    jobs = [1.0, 2.0]
+    ref, _, _ = _run_jobs(_DeltaPerformer, jobs, n_workers=1)
+    got, _, _ = _run_jobs(WedgeOnce, jobs, n_workers=2, job_timeout_s=0.3,
+                          eviction_timeout_s=10.0)
+    np.testing.assert_allclose(got, ref, atol=1e-12)
+    assert METRICS.snapshot()["counters"]["scaleout.job_timeouts"] == 1
+
+
+def test_file_model_saver_concurrent_saves_never_tear(tmp_path):
+    """Two savers hammering one path (the old shared-``.tmp`` collision):
+    every published file must be one complete pickle."""
+    saver = FileModelSaver(tmp_path / "model.bin")
+    payloads = [np.full(256, float(i)) for i in range(4)]
+    errors = []
+
+    def hammer(p):
+        try:
+            for _ in range(40):
+                saver.save(p)
+        except Exception as e:               # pragma: no cover - the bug
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(p,)) for p in payloads]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    loaded = saver.load()                    # parses -> not torn
+    assert any(np.array_equal(loaded, p) for p in payloads)
+    assert list(tmp_path.glob("*.tmp")) == []   # no leaked temp files
+
+
+# --------------------------------------------------------------------------- chaos smoke wiring
+
+def _load_chaos_smoke():
+    import importlib.util
+    import pathlib
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    spec = importlib.util.spec_from_file_location(
+        "chaos_smoke", tools / "chaos_smoke.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_chaos_smoke_fixed_seed():
+    """Tier-1 wiring for ``tools/chaos_smoke.py`` with a pinned seed: the
+    randomized tool must itself keep passing on a known draw."""
+    cs = _load_chaos_smoke()
+    result = cs.run(seed=0)
+    # run() asserts the invariants; pin the headline ones against refactor
+    assert result["params_bitwise_equal"]
+    assert result["loss_parity"]
+    assert result["final_step"] == result["ref_step"]
+    assert result["faults_injected"]
