@@ -293,10 +293,14 @@ def _pick_attention(rows):
               and isinstance(r.get("tokens_per_sec"), (int, float))]
         return max(ts) if ts else None
     ring, flash = best("ring"), best("flash")
-    if flash_ok and ring and flash and flash > ring:
+    # `is not None` (a 0.0-tok/s row is EVIDENCE of a broken config, not
+    # missing data) and a >2% margin so one noisy TUNE row can't flip the
+    # headline config on measurement jitter
+    if (flash_ok and ring is not None and flash is not None
+            and flash > ring * 1.02):
         return "flash", (f"TUNE: flash {flash:.0f} > ring {ring:.0f} tok/s "
-                         "at batch 64, flash_check passed")
-    return "ring", "default (no on-chip evidence that flash wins)"
+                         "(>2% margin) at batch 64, flash_check passed")
+    return "ring", "default (no on-chip evidence that flash wins by >2%)"
 
 
 def _pick_bn_fold(rows):
@@ -308,9 +312,12 @@ def _pick_bn_fold(rows):
               and isinstance(r.get("mfu"), (int, float))]
         return max(ms) if ms else None
     off, on = best(False), best(True)
-    if off and on and on > off:
-        return True, f"TUNE: bn_fold mfu {on:.3f} > {off:.3f} at batch 256"
-    return False, "default (no on-chip evidence that bn_fold wins)"
+    # `is not None` + >2% margin, same rationale as _pick_attention: a
+    # 0.0-MFU row must count as evidence and jitter must not flip defaults
+    if off is not None and on is not None and on > off * 1.02:
+        return True, (f"TUNE: bn_fold mfu {on:.3f} > {off:.3f} "
+                      "(>2% margin) at batch 256")
+    return False, "default (no on-chip evidence that bn_fold wins by >2%)"
 
 
 def _bert_leg(dev, on_tpu, conserve_hbm=False):

@@ -334,36 +334,50 @@ def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
     n_tok = B * T
     chunk = cfg.xent_chunk
 
-    def token_xent(h_flat, t_flat):
+    def token_xent(h_flat, t_flat, w_flat):
         logits = (h_flat.astype(cfg.dtype) @ hd).astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, t_flat[:, None], axis=-1)[:, 0]
-        return (lse - gold).sum()
+        return ((lse - gold) * w_flat).sum()
 
+    h_flat = h.reshape(n_tok, D)
+    t_flat = targets.reshape(n_tok)
+    w_flat = jnp.ones((n_tok,), jnp.float32)
     if chunk and n_tok > chunk:
         # largest divisor of n_tok <= chunk, so odd token counts still
         # stream instead of silently falling back to full (B*T, V) logits
-        while n_tok % chunk:
-            chunk -= 1
-        # a near-prime token count can drive the divisor search down to a
-        # tiny chunk — thousands of sequential (chunk, V) matmuls in the
-        # scan is far worse than one full-logits pass; if no divisor lands
-        # within 4x of the configured chunk, fall back to the full pass
-        if chunk < cfg.xent_chunk // 4:
-            chunk = 0
+        div = chunk
+        while n_tok % div:
+            div -= 1
+        if div >= cfg.xent_chunk // 4:
+            chunk = div
+        else:
+            # a near-prime token count drives the divisor search down to a
+            # tiny chunk — thousands of sequential (chunk, V) matmuls in
+            # the scan.  Materializing full (n_tok, V) logits instead is
+            # the exact OOM hazard this chunking exists to avoid, so: pad
+            # the token stream to a multiple of the CONFIGURED chunk with
+            # zero-WEIGHT pad tokens.  Pad rows contribute exactly 0 to
+            # the sum (and 0 cotangent to every param), and the mean still
+            # divides by the real token count.
+            pad = -n_tok % chunk
+            h_flat = jnp.concatenate([h_flat, jnp.zeros((pad, D), h_flat.dtype)])
+            t_flat = jnp.concatenate([t_flat, jnp.zeros((pad,), t_flat.dtype)])
+            w_flat = jnp.concatenate([w_flat, jnp.zeros((pad,), jnp.float32)])
 
     if chunk and 1 < chunk < n_tok:
         body_fn = jax.checkpoint(token_xent)
 
         def body(carry, inp):
-            h_c, t_c = inp
-            return carry + body_fn(h_c, t_c), None
+            h_c, t_c, w_c = inp
+            return carry + body_fn(h_c, t_c, w_c), None
 
         total, _ = lax.scan(
             body, jnp.zeros((), jnp.float32),
-            (h.reshape(-1, chunk, D), targets.reshape(-1, chunk)))
+            (h_flat.reshape(-1, chunk, D), t_flat.reshape(-1, chunk),
+             w_flat.reshape(-1, chunk)))
         return total / n_tok
-    return token_xent(h.reshape(n_tok, D), targets.reshape(n_tok)) / n_tok
+    return token_xent(h_flat, t_flat, w_flat) / n_tok
 
 
 # --------------------------------------------------------------------------- KV-cached decode
@@ -379,27 +393,36 @@ def init_decode_cache(cfg: TransformerConfig, batch: int = 1) -> list:
 
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One incremental decode step: ``tokens`` (B,) are the ids at
-    position ``pos`` (traced int); returns ``(logits (B, V) f32,
-    new_cache)``.  O(T·D) per token — each layer attends the single new
-    query against its cached K/V instead of recomputing the full T×T
-    attention.  Single-device path (the tp/sp sharded model trains; decode
-    serves), numerics mirror ``_block``: bf16 matmuls, f32 softmax/LN."""
+    position ``pos`` — a traced scalar (every row at the same depth: the
+    ``sample``/``beam_search`` path) or a ``(B,)`` vector of PER-ROW
+    positions (the serving slot pool, where every slot decodes at its own
+    depth).  Returns ``(logits (B, V) f32, new_cache)``.  O(T·D) per
+    token — each layer attends the single new query against its cached
+    K/V instead of recomputing the full T×T attention.  Single-device
+    path (the tp/sp sharded model trains; decode serves), numerics mirror
+    ``_block``: bf16 matmuls, f32 softmax/LN.  The vector-pos path runs
+    the same per-row arithmetic as the scalar path (broadcast + vmapped
+    row updates), so the two cannot diverge numerically."""
     dt = cfg.dtype
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), tokens.shape)  # (B,)
     x = (jnp.take(params["tok_embed"], tokens, axis=0)
-         + params["pos_embed"][pos]).astype(dt)                 # (B, D)
+         + jnp.take(params["pos_embed"], pos_b, axis=0)).astype(dt)  # (B, D)
     scale = cfg.head_dim ** -0.5
-    valid = jnp.arange(cfg.max_len) <= pos                       # (T,)
+    valid = jnp.arange(cfg.max_len)[None, :] <= pos_b[:, None]       # (B, T)
+    # per-row cache write: row b's K/V lands at its OWN position pos_b[b]
+    upd = jax.vmap(
+        lambda c, kv, p: lax.dynamic_update_slice_in_dim(c, kv[None], p, axis=0))
     new_cache = []
     for lp, c in zip(params["layers"], cache):
         h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
         qkv = jnp.einsum("bd,dshe->bshe", h.astype(dt), lp["wqkv"].astype(dt))
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]               # (B, H, Dh)
-        ck = lax.dynamic_update_slice_in_dim(c["k"], k[:, None], pos, axis=1)
-        cv = lax.dynamic_update_slice_in_dim(c["v"], v[:, None], pos, axis=1)
+        ck = upd(c["k"], k, pos_b)
+        cv = upd(c["v"], v, pos_b)
         new_cache.append({"k": ck, "v": cv})
         s = jnp.einsum("bhd,bthd->bht", q, ck,
                        preferred_element_type=jnp.float32) * scale
-        s = jnp.where(valid[None, None, :], s, -jnp.inf)
+        s = jnp.where(valid[:, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum("bht,bthd->bhd", p.astype(dt), cv,
                          preferred_element_type=jnp.float32).astype(dt)
@@ -411,6 +434,17 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
     return (h.astype(dt) @ head.astype(dt)).astype(jnp.float32), new_cache
+
+
+def reset_cache_slots(cache, slot_mask) -> list:
+    """Zero the K/V rows named by ``slot_mask`` (B,) bool — the serving
+    slot pool's eviction hygiene.  A newly admitted sequence's prefill
+    rewrites its row before any read, so this is defense-in-depth against
+    a stale-KV read ever influencing a later occupant (and makes cache
+    state inspectable in tests: an evicted slot is all-zeros)."""
+    def wipe(c):
+        return jnp.where(slot_mask[:, None, None, None], jnp.zeros_like(c), c)
+    return [{"k": wipe(c["k"]), "v": wipe(c["v"])} for c in cache]
 
 
 def encode_local(params, tokens, cfg: TransformerConfig, *,

@@ -53,8 +53,16 @@ def _empty_spec(param_specs):
 # trace time, so it composes with jit/shard_map.
 
 from contextlib import contextmanager
+from contextvars import ContextVar
 
-_DECAY_MASK_STACK: list = []
+# A ContextVar (not a module-level list): two threads tracing steps for
+# DIFFERENT models concurrently — the serving engine warming up while a
+# trainer builds its step, or two trainers in one process — must not see
+# each other's overrides; a shared list would leak one model's decay mask
+# into the other's tx.update.  Each thread (and each contextvars.Context)
+# observes only the overrides pushed on its own stack.
+_DECAY_MASK_STACK: ContextVar[tuple] = ContextVar("decay_mask_stack",
+                                                  default=())
 
 
 @contextmanager
@@ -62,18 +70,19 @@ def decay_mask_override(mask):
     """Override the decay-leaf choice for tx.update calls traced inside
     this context.  ``mask``: bool pytree matching the params tree handed
     to update (None = keep the ndim >= 2 default)."""
-    _DECAY_MASK_STACK.append(mask)
+    token = _DECAY_MASK_STACK.set(_DECAY_MASK_STACK.get() + (mask,))
     try:
         yield
     finally:
-        _DECAY_MASK_STACK.pop()
+        _DECAY_MASK_STACK.reset(token)
 
 
 def decay_leaf_mask(params):
     """Effective decay mask for ``params``: the innermost active override,
     else the ndim >= 2 heuristic."""
-    if _DECAY_MASK_STACK and _DECAY_MASK_STACK[-1] is not None:
-        return _DECAY_MASK_STACK[-1]
+    stack = _DECAY_MASK_STACK.get()
+    if stack and stack[-1] is not None:
+        return stack[-1]
     return tree_map(lambda w: jnp.ndim(w) >= 2, params)
 
 

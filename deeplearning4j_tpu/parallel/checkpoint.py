@@ -87,16 +87,40 @@ def _restore_like(template, arrays: dict[str, np.ndarray]):
 
 
 class CheckpointManager:
-    """Keep-last-N rotating checkpoints under a directory."""
+    """Keep-last-N rotating checkpoints under a directory.
 
-    def __init__(self, directory: str | Path, keep: int = 3):
+    ``read_only=True`` is the serving-side open path: verify / restore /
+    ``latest_valid_step`` only — the directory is never created (a typo'd
+    path fails loudly instead of serving from an empty dir) and ``save``
+    raises, so an inference process can never clobber the trainer's
+    rotation.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3,
+                 read_only: bool = False):
         self.directory = Path(directory)
-        self.directory.mkdir(parents=True, exist_ok=True)
+        self.read_only = read_only
+        if read_only:
+            if not self.directory.is_dir():
+                raise FileNotFoundError(
+                    f"checkpoint directory {self.directory} does not exist "
+                    "(read-only manager refuses to create it)")
+        else:
+            self.directory.mkdir(parents=True, exist_ok=True)
         self.keep = keep
+
+    @classmethod
+    def open_read_only(cls, directory: str | Path) -> "CheckpointManager":
+        """Open an EXISTING checkpoint directory for restore-only use."""
+        return cls(directory, read_only=True)
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, params, tstate=None, key=None,
              data_cursor: int = 0, extra: dict | None = None) -> Path:
+        if self.read_only:
+            raise RuntimeError(
+                "CheckpointManager opened read-only (serving open path): "
+                "save() is not allowed")
         with trace.span("checkpoint.save", step=step), \
                 METRICS.time("checkpoint.save"):
             # Fence before reading: under async dispatch the caller's latest

@@ -144,7 +144,10 @@ class PodSliceProvisioner:
         anything; pass ``dry_run=False`` where a cloud and ``gcloud``
         exist.  Returns one ``{"step", "cmd", "rc", "stdout"}`` record per
         command (``rc`` is None under dry-run); raises on the first
-        failing step, since later steps depend on earlier ones."""
+        failing step, since later steps depend on earlier ones.  A step
+        that exceeds ``timeout_s`` raises a ``RuntimeError`` naming the
+        step with the records-so-far attached as ``err.records`` (a
+        half-created slice keeps its audit trail)."""
         records = []
 
         def run(step: str, cmd: list[str]) -> str:
@@ -152,8 +155,19 @@ class PodSliceProvisioner:
             records.append(rec)
             if dry_run:
                 return ""
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout_s)
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=timeout_s)
+            except subprocess.TimeoutExpired as e:
+                # a timed-out create/bootstrap leaves a HALF-CREATED slice
+                # behind: name the step and carry the audit trail so the
+                # caller can tear down exactly what was attempted
+                err = RuntimeError(
+                    f"provision step {step!r} timed out after "
+                    f"{timeout_s:.0f}s — the slice may be half-created; "
+                    "inspect err.records and run teardown()")
+                err.records = records
+                raise err from e
             rec["rc"] = proc.returncode
             rec["stdout"] = proc.stdout.strip()
             if proc.returncode != 0:
@@ -185,8 +199,15 @@ class PodSliceProvisioner:
         cmd = self.delete_command()
         rec = {"step": "delete", "cmd": cmd, "rc": None, "stdout": ""}
         if not dry_run:
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=timeout_s)
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=timeout_s)
+            except subprocess.TimeoutExpired as e:
+                err = RuntimeError(
+                    f"teardown step 'delete' timed out after {timeout_s:.0f}s "
+                    "— the slice may still exist; inspect err.records")
+                err.records = [rec]
+                raise err from e
             rec["rc"] = proc.returncode
             rec["stdout"] = proc.stdout.strip()
             if proc.returncode != 0:

@@ -27,6 +27,14 @@ site                   effect when armed
                        a job (straggler simulation)
 ``scaleout.perform``   :class:`TransientStepFault` raised inside the job
                        execution path (prompt failure -> requeue/quarantine)
+``serving.request``    :class:`TransientStepFault` raised at request
+                       submission (``RequestQueue.submit``) — the HTTP
+                       layer's 503 path
+``serving.decode``     one decode-segment dispatch skipped
+                       (``InferenceEngine``, via ``FAULTS.check``) — a
+                       transient decode hiccup; engine state is untouched
+                       and the next round retries, so completions stay
+                       token-identical
 =====================  =====================================================
 
 Arming:
@@ -114,6 +122,8 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "preempt": PreemptionSignal,
     "scaleout.worker": WorkerKilled,
     "scaleout.perform": TransientStepFault,
+    "serving.request": TransientStepFault,
+    "serving.decode": TransientStepFault,
 }
 
 

@@ -1,0 +1,34 @@
+"""Model serving: continuous-batching inference over trained checkpoints.
+
+The inference half of the north star ("serves heavy traffic from millions
+of users", ROADMAP.md): :class:`InferenceEngine` keeps a slot-pool KV
+cache full of concurrently-decoding sequences, :class:`BatchScorer`
+coalesces forward/score calls for ``MultiLayerNetwork``/zoo models,
+:class:`RequestQueue` applies deadline-aware admission control with
+bounded-queue backpressure, and :class:`ModelServer` exposes the whole
+thing over stdlib HTTP with Prometheus metrics.  See DESIGN.md §13.
+"""
+
+from .batcher import (Completion, DeadlineExceeded, GenerateRequest,
+                      PendingResult, QueueFull, RequestQueue, ScoreRequest,
+                      ServingRejected)
+from .client import ServingClient, ServingError
+from .engine import BatchScorer, InferenceEngine, ServingConfig
+from .server import ModelServer
+
+__all__ = [
+    "BatchScorer",
+    "Completion",
+    "DeadlineExceeded",
+    "GenerateRequest",
+    "InferenceEngine",
+    "ModelServer",
+    "PendingResult",
+    "QueueFull",
+    "RequestQueue",
+    "ScoreRequest",
+    "ServingClient",
+    "ServingConfig",
+    "ServingError",
+    "ServingRejected",
+]

@@ -1,0 +1,194 @@
+"""Request admission: bounded queue, deadlines, coalescing (DESIGN.md §13).
+
+The serving front door.  Backpressure is explicit and cheap: the queue is
+bounded and a full queue rejects AT SUBMIT TIME with :class:`QueueFull`
+(the HTTP layer maps it to 429) instead of buffering unbounded work the
+engine can never catch up on — the TensorFlow-Serving batching discipline
+(PAPERS.md, Abadi et al. 2016).  Deadline-aware admission: a request whose
+deadline expired while queued is dropped at admission time (it completes
+exceptionally with :class:`DeadlineExceeded`) and never occupies a decode
+slot — decoding tokens nobody will wait for is the most expensive way to
+miss an SLO.  Coalescing: when the engine is idle, :meth:`RequestQueue.take`
+holds the first arrival up to ``max_batch_delay_ms`` waiting for
+companions, so the first device batch after an idle period dispatches
+fuller (latency traded for fill ratio, bounded by the window).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from ..observability import METRICS
+from ..resilience.faults import FAULTS
+
+
+class ServingRejected(RuntimeError):
+    """Base of the load-shedding rejections; ``status`` is the HTTP code
+    the server layer answers with."""
+
+    status = 503
+
+
+class QueueFull(ServingRejected):
+    """The bounded request queue is at capacity — back off and retry."""
+
+    status = 429
+
+
+class DeadlineExceeded(ServingRejected):
+    """The request's deadline passed while it was still queued."""
+
+    status = 504
+
+
+_REQ_IDS = itertools.count(1)
+
+
+@dataclasses.dataclass
+class GenerateRequest:
+    """One autoregressive generation request (token-id space — tokenizers
+    live outside this framework, as in ``Transformer.sample``)."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0        # <= 0 -> greedy, like Transformer.sample
+    seed: int = 0                   # per-request RNG stream: jax.random.key(seed)
+    eos_id: int | None = None       # evict the slot early on this token
+    deadline_s: float | None = None  # absolute time.monotonic() deadline
+    id: int = dataclasses.field(default_factory=lambda: next(_REQ_IDS))
+    submitted_s: float = 0.0        # stamped by RequestQueue.submit
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One row of a batched forward/score call (``BatchScorer``)."""
+
+    x: Any
+    deadline_s: float | None = None
+    id: int = dataclasses.field(default_factory=lambda: next(_REQ_IDS))
+    submitted_s: float = 0.0
+
+
+@dataclasses.dataclass
+class Completion:
+    """Terminal result of a generation request."""
+
+    tokens: list[int]
+    finish_reason: str              # "eos" | "length"
+    latency_s: float = 0.0
+    ttft_s: float | None = None     # fence-granular time to first token
+
+
+class PendingResult:
+    """Caller-facing handle for a submitted request: ``result()`` blocks
+    until the engine completes (or fails) it."""
+
+    def __init__(self, request):
+        self.request = request
+        self._done = threading.Event()
+        self._value: Any = None
+        self._exc: BaseException | None = None
+
+    # -- engine side ----------------------------------------------------
+    def _complete(self, value) -> None:
+        self._value = value
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+    # -- caller side ----------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.id} not completed within {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class RequestQueue:
+    """Bounded FIFO between submitters (HTTP handler threads, direct
+    callers) and the single engine loop."""
+
+    def __init__(self, max_depth: int = 64, max_batch_delay_ms: float = 2.0):
+        self.max_depth = max_depth
+        self.max_batch_delay_ms = max_batch_delay_ms
+        self._cv = threading.Condition()
+        self._items: deque[PendingResult] = deque()
+
+    def submit(self, request) -> PendingResult:
+        """Enqueue or reject — never blocks the submitter."""
+        FAULTS.maybe_fire("serving.request")
+        with self._cv:
+            if len(self._items) >= self.max_depth:
+                METRICS.increment("serving.rejected")
+                raise QueueFull(
+                    f"request queue full ({self.max_depth} deep) — retry "
+                    "with backoff")
+            request.submitted_s = time.monotonic()
+            pending = PendingResult(request)
+            self._items.append(pending)
+            METRICS.gauge("serving.queue.depth", len(self._items))
+            self._cv.notify()
+        return pending
+
+    def take(self, max_n: int, block_s: float = 0.0) -> list[PendingResult]:
+        """Up to ``max_n`` admissible requests.
+
+        ``block_s > 0`` is the IDLE path: wait up to ``block_s`` for a
+        first arrival, then hold it up to ``max_batch_delay_ms`` for
+        companions (coalescing).  ``block_s == 0`` is the busy path —
+        return whatever is queued right now, the decode loop must not
+        stall.  Requests whose deadline already passed are completed
+        exceptionally here and never returned.
+        """
+        if max_n <= 0:
+            return []
+        out: list[PendingResult] = []
+        with self._cv:
+            if not self._items and block_s > 0:
+                self._cv.wait(block_s)
+            if self._items and block_s > 0 and len(self._items) < max_n \
+                    and self.max_batch_delay_ms > 0:
+                end = time.monotonic() + self.max_batch_delay_ms / 1000.0
+                while len(self._items) < max_n:
+                    left = end - time.monotonic()
+                    if left <= 0 or not self._cv.wait(left):
+                        break
+            now = time.monotonic()
+            while self._items and len(out) < max_n:
+                p = self._items.popleft()
+                dl = p.request.deadline_s
+                if dl is not None and now > dl:
+                    METRICS.increment("serving.deadline_dropped")
+                    p._fail(DeadlineExceeded(
+                        f"request {p.request.id} expired after "
+                        f"{now - p.request.submitted_s:.3f}s in queue"))
+                    continue
+                METRICS.observe_time("serving.queue_wait",
+                                     now - p.request.submitted_s)
+                out.append(p)
+            METRICS.gauge("serving.queue.depth", len(self._items))
+        return out
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def drain(self) -> list[PendingResult]:
+        """Remove and return everything queued (engine shutdown path)."""
+        with self._cv:
+            out = list(self._items)
+            self._items.clear()
+            METRICS.gauge("serving.queue.depth", 0)
+        return out

@@ -1,0 +1,80 @@
+"""Minimal stdlib client for :class:`~.server.ModelServer`.
+
+``urllib``-based (the repo ships no HTTP client dependency) — the serving
+counterpart of the reference's REST client seams.  Rejections surface as
+:class:`ServingError` carrying the HTTP status, so callers can tell
+backpressure (429 — back off and retry) from bad requests (400) apart
+without parsing strings.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class ServingError(RuntimeError):
+    """An HTTP error answer from the model server."""
+
+    def __init__(self, status: int, detail: str):
+        super().__init__(f"HTTP {status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+class ServingClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 timeout_s: float = 60.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------ transport
+    def _request(self, path: str, payload: dict | None = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method="POST" if data else "GET",
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                body = r.read()
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                detail = json.loads(raw).get("error", raw.decode("utf-8", "replace"))
+            except (ValueError, AttributeError):
+                detail = raw.decode("utf-8", "replace")
+            raise ServingError(e.code, detail) from e
+        return body
+
+    def _json(self, path: str, payload: dict | None = None) -> dict:
+        return json.loads(self._request(path, payload))
+
+    # ------------------------------------------------------------ API
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id: int | None = None,
+                 deadline_ms: float | None = None) -> dict:
+        body = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
+                "temperature": temperature, "seed": seed}
+        if eos_id is not None:
+            body["eos_id"] = eos_id
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        return self._json("/v1/generate", body)
+
+    def score(self, inputs) -> list:
+        return self._json("/v1/score", {"inputs": [list(map(float, r))
+                                                   for r in inputs]})["outputs"]
+
+    def reload(self) -> int:
+        return self._json("/v1/reload", {})["step"]
+
+    def healthz(self) -> dict:
+        return self._json("/healthz")
+
+    def metrics(self) -> dict:
+        return self._json("/metrics")
+
+    def metrics_prom(self) -> str:
+        return self._request("/metrics.prom").decode()

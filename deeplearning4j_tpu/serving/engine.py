@@ -1,0 +1,610 @@
+"""Continuous-batching inference engine (DESIGN.md §13).
+
+Two workloads over one discipline — keep the device batch full, keep the
+host off the per-token path:
+
+- :class:`InferenceEngine`: slot-based continuous batching for the
+  flagship transformer.  The KV cache is a POOL of ``slots`` rows
+  (``(S, max_len, H, Dh)`` per layer); every decode step advances ALL
+  occupied slots one token through :func:`decode_step` with per-slot
+  positions, new sequences are admitted into free rows between steps
+  (prefill on a batch-of-1 cache, then one scatter into the pool), and a
+  finished sequence (EOS / length budget) frees its row for the next
+  arrival.  Sequences at different depths share every device batch —
+  ragged traffic cannot drain the batch the way static batching does.
+
+- :class:`BatchScorer`: batched forward/score for ``MultiLayerNetwork``
+  and zoo models — concurrent callers coalesce into one padded
+  (power-of-two bucket) device batch through any row-wise ``fn``.
+
+Hot-path rules (PR-2/PR-3 heritage): the decode loop dispatches
+``resolve_every`` steps back-to-back under ``hot_loop_guard()`` — zero
+host syncs per token — and resolves the emitted-token stack at ONE
+``allow_transfers()`` fence per segment, where EOS/length bookkeeping,
+admissions, and metrics publication happen.  Every jitted entry donates
+the engine state, so the cache pool is updated in place.
+
+RNG parity contract: slot ``s`` runs the exact draw sequence of
+``Transformer.sample(..., key=jax.random.key(seed), kv_cache=True)`` —
+split once per generated token, sample from the second half — so a
+served continuation is token-identical to the offline sampler under the
+same seed (the tier-1 acceptance test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..analysis.runtime import allow_transfers, hot_loop_guard
+from ..models.transformer import (decode_step, init_decode_cache,
+                                  reset_cache_slots)
+from ..observability import METRICS, trace
+from ..parallel.checkpoint import CheckpointManager
+from ..parallel.compile_cache import setup_compile_cache
+from ..resilience.faults import FAULTS
+from .batcher import (Completion, GenerateRequest, PendingResult,
+                      RequestQueue, ScoreRequest)
+
+#: unit-interval buckets for fill-ratio histograms (observe_time is the
+#: registry's generic histogram feed; these are ratios, not seconds)
+FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine knobs (the model's own shape lives in TransformerConfig)."""
+
+    slots: int = 4                  # concurrent sequences in the device batch
+    resolve_every: int = 4          # decode steps dispatched per host fence
+    max_queue: int = 64             # RequestQueue bound (429 beyond)
+    max_batch_delay_ms: float = 2.0  # idle coalescing window
+    min_prefill_bucket: int = 8     # floor of the prompt bucket ladder
+    idle_wait_s: float = 0.05       # queue poll period while no slot is live
+    default_eos_id: int | None = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host-side record of one occupied cache row."""
+
+    pending: PendingResult
+    delivered: list = dataclasses.field(default_factory=list)
+    admitted_s: float = 0.0
+    first_token_s: float | None = None
+
+
+class InferenceEngine:
+    """Continuous-batching decode over a trained ``TransformerLM``.
+
+    ``params`` may be passed directly, or loaded from ``checkpoint`` (a
+    directory path or a :class:`CheckpointManager`) — the engine opens
+    checkpoint directories READ-ONLY and restores ``latest_valid_step()``.
+    ``model.init`` shapes the restore template, so the checkpoint must
+    match ``model.cfg``.
+    """
+
+    def __init__(self, model, params=None, checkpoint=None,
+                 cfg: ServingConfig = ServingConfig(),
+                 compile_cache_dir: str | None = None):
+        # PR-2 warmup integration: with a persistent cache dir configured
+        # (env or explicit), the warmup compiles below hit disk
+        setup_compile_cache(compile_cache_dir)
+        self.model = model
+        self.cfg = cfg
+        self._queue = RequestQueue(cfg.max_queue, cfg.max_batch_delay_ms)
+        self._ckpt: CheckpointManager | None = None
+        self._loaded_step: int | None = None
+        if checkpoint is not None:
+            self._ckpt = (checkpoint if isinstance(checkpoint, CheckpointManager)
+                          else CheckpointManager.open_read_only(checkpoint))
+        if params is None:
+            if self._ckpt is None:
+                raise ValueError("need params or a checkpoint to serve from")
+            step = self._ckpt.latest_valid_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no verified checkpoint under {self._ckpt.directory}")
+            template = model.init(jax.random.key(0))
+            restored = self._ckpt.restore(template, step=step)
+            params = restored["params"]
+            self._loaded_step = restored["step"]
+        self._params = params
+        self._state = self._init_state()
+        self._step_fn = jax.jit(self._build_step(), donate_argnums=(1,))
+        self._step_compiled = False
+        self._admit_fns: dict[int, Callable] = {}
+        self._slots: dict[int, _Slot] = {}
+        self._free: list[int] = list(range(cfg.slots))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()   # guards _params swap vs. read
+        self._admitted = 0
+        self._completed = 0
+
+    # ------------------------------------------------------------ device state
+    def _init_state(self) -> dict:
+        cfg = self.model.cfg
+        S = self.cfg.slots
+        return {
+            "cache": init_decode_cache(cfg, S),
+            "toks": jnp.zeros((S, cfg.max_len), jnp.int32),
+            "pos": jnp.zeros((S,), jnp.int32),
+            "limit": jnp.zeros((S,), jnp.int32),
+            "temp": jnp.zeros((S,), jnp.float32),
+            "keys": jax.random.split(jax.random.key(0), S),
+            "active": jnp.zeros((S,), bool),
+        }
+
+    def _build_step(self) -> Callable:
+        cfg = self.model.cfg
+
+        def step(params, state):
+            """Advance every occupied slot one token.
+
+            Inactive / exhausted rows still flow through the batched
+            matmuls (masked no-ops — cheaper than reshaping the batch),
+            but their RNG keys, positions and token buffers are frozen
+            and they emit -1.
+            """
+            toks, pos = state["toks"], state["pos"]
+            temp, active, limit = state["temp"], state["active"], state["limit"]
+            row = jnp.arange(toks.shape[0])
+            cur = toks[row, pos]
+            logits, cache = decode_step(params, state["cache"], cur, pos, cfg)
+            # per-slot RNG, exactly Transformer.sample's kv stream: split
+            # the slot key, carry the first half, draw from the second
+            pair = jax.vmap(jax.random.split)(state["keys"])    # (S, 2) keys
+            carry, sub = pair[:, 0], pair[:, 1]
+            safe_t = jnp.where(temp > 0, temp, 1.0)
+            drawn = jax.vmap(jax.random.categorical)(
+                sub, logits / safe_t[:, None])
+            pick = jnp.where(temp > 0, drawn.astype(jnp.int32),
+                             jnp.argmax(logits, axis=-1).astype(jnp.int32))
+            can = active & (pos < limit) & (pos + 1 < cfg.max_len)
+            emitted = jnp.where(can, pick, -1)
+            new_pos = jnp.where(can, pos + 1, pos)
+            toks = toks.at[row, new_pos].set(
+                jnp.where(can, pick, toks[row, new_pos]))
+            kd = jax.random.key_data(state["keys"])
+            keys = jax.random.wrap_key_data(
+                jnp.where(can[:, None], jax.random.key_data(carry), kd))
+            new_state = dict(state, cache=cache, toks=toks, pos=new_pos,
+                             keys=keys)
+            return new_state, emitted
+
+        return step
+
+    # ------------------------------------------------------------ prefill
+    def _prompt_bucket(self, n: int) -> int:
+        """Power-of-two prompt ladder (the PR-2 pad-batch discipline):
+        one compiled prefill per bucket, so recompiles are bounded by
+        ``log2(max_len)`` regardless of prompt-length diversity."""
+        b = self.cfg.min_prefill_bucket
+        while b < n:
+            b <<= 1
+        return min(b, self.model.cfg.max_len)
+
+    def _admit_for(self, bucket: int) -> Callable:
+        cached = self._admit_fns.get(bucket)
+        if cached is not None:
+            return cached
+        cfg = self.model.cfg
+
+        def admit(params, state, prompt, p_len, slot, key, temp, max_new):
+            """Prefill ``prompt[:p_len]`` on a batch-of-1 cache through
+            the SAME ``decode_step`` the steady loop uses (numerics cannot
+            diverge from ``Transformer.sample``'s kv path), then scatter
+            the row into cache-pool row ``slot``.  Iterations past
+            ``p_len - 1`` are masked no-ops: one executable per bucket."""
+            cache1 = init_decode_cache(cfg, 1)
+            last = jnp.maximum(p_len - 2, 0)
+
+            def body(i, c):
+                ii = jnp.minimum(i, last)
+                _, c_new = decode_step(
+                    params, c, lax.dynamic_slice(prompt, (ii,), (1,)), ii, cfg)
+                use = i < p_len - 1
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(use, a, b), c_new, c)
+
+            cache1 = lax.fori_loop(0, bucket, body, cache1)
+            cache = [
+                {"k": lax.dynamic_update_slice_in_dim(c["k"], c1["k"], slot,
+                                                      axis=0),
+                 "v": lax.dynamic_update_slice_in_dim(c["v"], c1["v"], slot,
+                                                      axis=0)}
+                for c, c1 in zip(state["cache"], cache1)]
+            toks = lax.dynamic_update_slice(
+                state["toks"], prompt[None, :], (slot, jnp.int32(0)))
+
+            def put1(arr, v):
+                return lax.dynamic_update_slice(
+                    arr, jnp.reshape(v, (1,)).astype(arr.dtype), (slot,))
+
+            kd = lax.dynamic_update_slice(
+                jax.random.key_data(state["keys"]),
+                jax.random.key_data(key)[None], (slot, jnp.int32(0)))
+            return dict(
+                state,
+                cache=cache,
+                toks=toks,
+                # sample() prefills tokens 0..P-2; the first engine step
+                # then processes token P-1 and draws the first new token
+                pos=put1(state["pos"], p_len - 1),
+                limit=put1(state["limit"], p_len - 1 + max_new),
+                temp=put1(state["temp"], temp),
+                active=put1(state["active"], True),
+                keys=jax.random.wrap_key_data(kd),
+            )
+
+        prefill = jax.jit(admit, donate_argnums=(1,))
+        self._admit_fns[bucket] = prefill
+        METRICS.increment("serving.prefill.recompile")
+        return prefill
+
+    # ------------------------------------------------------------ submission
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               seed: int = 0, eos_id: int | None = None,
+               deadline_ms: float | None = None) -> PendingResult:
+        """Validate + enqueue; returns a handle whose ``result()`` blocks.
+        Raises ``ValueError`` on malformed requests (HTTP 400) and
+        :class:`~.batcher.QueueFull` under backpressure (HTTP 429)."""
+        cfg = self.model.cfg
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < cfg.vocab_size for t in prompt):
+            raise ValueError(f"prompt token out of range [0, {cfg.vocab_size})")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({cfg.max_len})")
+        req = GenerateRequest(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), seed=int(seed),
+            eos_id=eos_id if eos_id is not None else self.cfg.default_eos_id,
+            deadline_s=(time.monotonic() + deadline_ms / 1000.0
+                        if deadline_ms else None))
+        METRICS.increment("serving.requests")
+        return self._queue.submit(req)
+
+    def generate(self, prompt, max_new_tokens: int, timeout: float = 60.0,
+                 **kw) -> Completion:
+        """Blocking convenience wrapper over :meth:`submit`."""
+        return self.submit(prompt, max_new_tokens, **kw).result(timeout)
+
+    # ------------------------------------------------------------ serve loop
+    def start(self, warmup: bool = True) -> "InferenceEngine":
+        if self._thread is not None:
+            return self
+        if warmup:
+            self.warmup()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._serve_loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        for s in list(self._slots):
+            self._slots.pop(s).pending._fail(
+                RuntimeError("engine stopped with request in flight"))
+        for p in self._queue.drain():
+            p._fail(RuntimeError("engine stopped before request was admitted"))
+
+    def __enter__(self) -> "InferenceEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def warmup(self) -> None:
+        """Compile the steady-state step and the smallest prefill bucket
+        before traffic (with the PR-2 persistent compile cache configured
+        these are disk hits on restart) — first-request latency pays
+        trace+lower cost at most once, at startup."""
+        with allow_transfers(), METRICS.time("serving.warmup"):
+            state, _ = self._step_fn(self._params, self._state)
+            self._step_compiled = True
+            bucket = self._prompt_bucket(1)
+            fn = self._admit_for(bucket)
+            state = fn(self._params, state,
+                       jnp.zeros((bucket,), jnp.int32), jnp.int32(1),
+                       jnp.int32(0), jax.random.key(0), jnp.float32(0.0),
+                       jnp.int32(0))
+            # the warmup admit occupied slot 0 with a dummy — deactivate
+            self._state = dict(state, active=jnp.zeros_like(state["active"]))
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._serve_once()
+            except Exception as e:  # defensive: a wedged loop strands callers
+                METRICS.increment("serving.engine.errors")
+                for s in list(self._slots):
+                    self._slots.pop(s).pending._fail(e)
+                self._free = list(range(self.cfg.slots))
+                with allow_transfers():
+                    self._state = self._init_state()
+
+    def _serve_once(self) -> None:
+        idle = not self._slots
+        n_free = len(self._free)
+        if n_free:
+            batch = self._queue.take(
+                n_free, block_s=self.cfg.idle_wait_s if idle else 0.0)
+            if batch:
+                # admission is a deliberate host<->device seam (prompt
+                # upload, request bookkeeping) — annotated, off the
+                # per-token path
+                with allow_transfers(), trace.span("serving.admit"):
+                    self._admit(batch)
+        if not self._slots:
+            return
+        METRICS.observe_time("serving.batch_fill_ratio",
+                             len(self._slots) / self.cfg.slots,
+                             buckets=FILL_BUCKETS)
+        t0 = time.perf_counter()
+        with hot_loop_guard():
+            pending = self._decode_segment()
+        with allow_transfers(), trace.span("serving.resolve"):
+            self._resolve(pending, t0)
+
+    def _admit(self, batch: list[PendingResult]) -> None:
+        for p in batch:
+            slot = self._free.pop()
+            req: GenerateRequest = p.request
+            try:
+                bucket = self._prompt_bucket(len(req.prompt))
+                prompt = np.zeros((bucket,), np.int32)
+                prompt[:len(req.prompt)] = req.prompt
+                admit_fn = self._admit_for(bucket)
+                with self._lock:
+                    params = self._params
+                self._state = admit_fn(
+                    params, self._state, jnp.asarray(prompt),
+                    jnp.int32(len(req.prompt)), jnp.int32(slot),
+                    jax.random.key(req.seed), jnp.float32(req.temperature),
+                    jnp.int32(req.max_new_tokens))
+            except Exception as e:
+                # fail only THIS request — the slot goes back to the pool
+                # and the rest of the batch still admits
+                self._free.append(slot)
+                METRICS.increment("serving.engine.errors")
+                p._fail(e)
+                continue
+            self._slots[slot] = _Slot(pending=p, admitted_s=time.monotonic())
+            self._admitted += 1
+            METRICS.increment("serving.admitted")
+
+    def _decode_segment(self) -> list:
+        """Dispatch ``resolve_every`` decode steps with NO host syncs —
+        the emitted-token arrays stay on device until ``_resolve``."""
+        out = []
+        step_fn = self._step_fn
+        with self._lock:
+            params = self._params
+        for _ in range(self.cfg.resolve_every):
+            if FAULTS.check("serving.decode") is not None:
+                # transient decode fault (chaos): this dispatch is skipped,
+                # state is untouched, the next round retries — completions
+                # stay token-identical under injection
+                METRICS.increment("serving.decode.faults")
+                continue
+            self._state, emitted = step_fn(params, self._state)
+            out.append(emitted)
+        METRICS.increment("serving.decode.dispatches", len(out))
+        return out
+
+    def _resolve(self, pending: list, t0: float) -> None:
+        """The per-segment fence: ONE host pull for the whole segment's
+        emitted tokens, then EOS/length bookkeeping and metrics."""
+        if not pending:
+            return
+        em = np.asarray(jax.device_get(jnp.stack(pending)))     # (k, S)
+        now = time.monotonic()
+        seg_s = time.perf_counter() - t0
+        n_steps = len(pending)
+        METRICS.observe_many("serving.decode_step", [seg_s / n_steps] * n_steps)
+        delivered = 0
+        for s in list(self._slots):
+            sl = self._slots[s]
+            req: GenerateRequest = sl.pending.request
+            finish = None
+            for t in em[:, s]:
+                t = int(t)
+                if t < 0:
+                    continue
+                delivered += 1
+                if sl.first_token_s is None:
+                    sl.first_token_s = now  # fence granularity, documented
+                    METRICS.observe_time("serving.ttft",
+                                         now - req.submitted_s)
+                sl.delivered.append(t)
+                if req.eos_id is not None and t == req.eos_id:
+                    finish = "eos"
+                    break
+                if len(sl.delivered) >= req.max_new_tokens:
+                    finish = "length"
+                    break
+            if finish is not None:
+                self._evict(s, finish, now)
+        if delivered:
+            METRICS.increment("serving.tokens", delivered)
+            if seg_s > 0:
+                METRICS.gauge("serving.tokens_per_sec", delivered / seg_s)
+
+    def _evict(self, s: int, finish: str, now: float) -> None:
+        """Free slot ``s``: complete the caller, drop the host record,
+        deactivate the row and wipe its K/V (tokens the segment over-
+        decoded past EOS died here, discarded at the fence)."""
+        sl = self._slots.pop(s)
+        mask = np.zeros((self.cfg.slots,), bool)
+        mask[s] = True
+        self._state = dict(
+            self._state,
+            cache=reset_cache_slots(self._state["cache"], jnp.asarray(mask)),
+            active=self._state["active"].at[s].set(False))
+        self._free.append(s)
+        self._completed += 1
+        req = sl.pending.request
+        METRICS.increment("serving.completed")
+        METRICS.observe_time("serving.request_latency", now - req.submitted_s)
+        sl.pending._complete(Completion(
+            tokens=list(sl.delivered), finish_reason=finish,
+            latency_s=now - req.submitted_s,
+            ttft_s=(sl.first_token_s - req.submitted_s
+                    if sl.first_token_s is not None else None)))
+
+    # ------------------------------------------------------------ hot reload
+    def reload(self) -> int:
+        """Atomic hot swap to ``latest_valid_step()`` WITHOUT draining:
+        in-flight segments finish on the params they dispatched with; the
+        next dispatch reads the new tree.  Shapes are fixed by the config,
+        so the swap hits the existing executables — no recompile, no
+        pause.  Returns the loaded step."""
+        if self._ckpt is None:
+            raise RuntimeError("no checkpoint attached — nothing to reload")
+        step = self._ckpt.latest_valid_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no verified checkpoint under {self._ckpt.directory}")
+        if step == self._loaded_step:
+            return step
+        with allow_transfers(), METRICS.time("serving.reload"):
+            restored = self._ckpt.restore(self._params, step=step)
+        with self._lock:
+            self._params = restored["params"]
+        self._loaded_step = step
+        METRICS.increment("serving.reloads")
+        METRICS.gauge("serving.loaded_step", step)
+        return step
+
+    # ------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        return {
+            "slots": self.cfg.slots,
+            "active": len(self._slots),
+            "free": len(self._free),
+            "queue_depth": self._queue.depth(),
+            "admitted": self._admitted,
+            "completed": self._completed,
+            "loaded_step": self._loaded_step,
+            "prefill_buckets": sorted(self._admit_fns),
+            "running": self._thread is not None,
+        }
+
+
+class BatchScorer:
+    """Coalesce concurrent single-row score calls into padded device
+    batches through any row-wise pure ``fn`` (``net.output``, a zoo
+    model's jitted apply, a ``partial(forward_local, ...)``).
+
+    Rows queue through the same bounded :class:`RequestQueue` as
+    generation (shared backpressure semantics); the worker pads each
+    batch up to a power-of-two bucket (repeating the first row — pad
+    outputs are discarded) so ``fn``'s jit cache sees at most
+    ``log2(max_batch)`` shapes.
+    """
+
+    def __init__(self, fn: Callable, max_batch: int = 64,
+                 max_queue: int = 256, max_batch_delay_ms: float = 2.0):
+        self.fn = fn
+        self.max_batch = max_batch
+        self._queue = RequestQueue(max_queue, max_batch_delay_ms)
+        self._row_shape: tuple | None = None
+        self._row_dtype = None
+        self._buckets: set[int] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "BatchScorer":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="serving-scorer")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        for p in self._queue.drain():
+            p._fail(RuntimeError("scorer stopped before request ran"))
+
+    def __enter__(self) -> "BatchScorer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def submit(self, x) -> PendingResult:
+        x = np.asarray(x)
+        if self._row_shape is None:
+            self._row_shape, self._row_dtype = x.shape, x.dtype
+        elif x.shape != self._row_shape:
+            raise ValueError(
+                f"row shape {x.shape} != first-seen {self._row_shape}")
+        return self._queue.submit(ScoreRequest(x=x))
+
+    def score(self, x, timeout: float = 30.0):
+        """One row in, one output row out (blocking)."""
+        return self.submit(x).result(timeout)
+
+    def score_batch(self, xs, timeout: float = 30.0) -> np.ndarray:
+        """Submit every row, gather in order — rows from concurrent
+        callers interleave into shared device batches."""
+        handles = [self.submit(x) for x in np.asarray(xs)]
+        return np.stack([h.result(timeout) for h in handles])
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b <<= 1
+        return min(b, self.max_batch)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._queue.take(self.max_batch, block_s=0.05)
+            if not batch:
+                continue
+            try:
+                self._run(batch)
+            except Exception as e:
+                METRICS.increment("serving.score.errors")
+                for p in batch:
+                    p._fail(e)
+
+    def _run(self, batch: list[PendingResult]) -> None:
+        n = len(batch)
+        bucket = self._bucket(n)
+        xs = np.stack([p.request.x for p in batch])
+        if bucket > n:
+            xs = np.concatenate(
+                [xs, np.broadcast_to(xs[:1], (bucket - n,) + xs.shape[1:])])
+        if bucket not in self._buckets:
+            self._buckets.add(bucket)
+            METRICS.increment("serving.score.recompile")
+        with METRICS.time("serving.score_batch"):
+            ys = np.asarray(self.fn(xs))
+        METRICS.observe_time("serving.score.batch_fill", n / bucket,
+                             buckets=FILL_BUCKETS)
+        METRICS.increment("serving.score.rows", n)
+        for i, p in enumerate(batch):
+            p._complete(ys[i])

@@ -1,0 +1,173 @@
+"""HTTP front end for the inference engine (DESIGN.md §13).
+
+Stdlib ``ThreadingHTTPServer`` in the PR-1 ``StatusServer`` idiom (inner
+handler class over the outer server's state, ``port=0`` auto-assign,
+silenced request logging) — serving shares the observability stack's
+transport, not a new framework:
+
+- ``POST /v1/generate``  — continuous-batching decode; body
+  ``{"prompt": [ids], "max_new_tokens", "temperature", "seed", "eos_id",
+  "deadline_ms"}`` → ``{"tokens", "finish_reason", "latency_s", "ttft_s"}``
+- ``POST /v1/score``     — batched forward; ``{"inputs": [[...], ...]}``
+  → ``{"outputs": [[...], ...]}``
+- ``POST /v1/reload``    — hot swap to ``latest_valid_step()``
+- ``GET  /healthz``      — liveness + engine slot/queue stats
+- ``GET  /metrics``      — JSON registry snapshot
+- ``GET  /metrics.prom`` — Prometheus text exposition (scrape target)
+
+Error contract: backpressure rejections keep their HTTP status
+(:class:`~.batcher.QueueFull` → 429, :class:`~.batcher.DeadlineExceeded`
+→ 504), malformed requests → 400, reload with nothing to load → 409,
+injected transients → 503 — load shedding is part of the API, not an
+exception trace.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..observability import METRICS, MetricsRegistry
+from ..resilience.faults import InjectedFault
+from .batcher import ServingRejected
+
+
+class ModelServer:
+    """REST endpoint over an :class:`~.engine.InferenceEngine` and/or a
+    :class:`~.engine.BatchScorer` (either may be None; its route 400s)."""
+
+    def __init__(self, engine=None, scorer=None,
+                 registry: MetricsRegistry = METRICS,
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 60.0):
+        self.engine = engine
+        self.scorer = scorer
+        self.registry = registry
+        self.request_timeout_s = request_timeout_s
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      content_type: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, code: int, payload) -> None:
+                self._send(code, json.dumps(payload).encode())
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, outer._health())
+                elif self.path == "/metrics":
+                    self._json(200, outer.registry.snapshot())
+                elif self.path == "/metrics.prom":
+                    self._send(200, outer.registry.to_prometheus().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, json.JSONDecodeError) as e:
+                    return self._json(400, {"error": f"bad request body: {e}"})
+                try:
+                    if self.path == "/v1/generate":
+                        return self._json(200, outer._generate(payload))
+                    if self.path == "/v1/score":
+                        return self._json(200, outer._score(payload))
+                    if self.path == "/v1/reload":
+                        return self._json(200, outer._reload())
+                    return self._json(404, {"error": f"no route {self.path}"})
+                except ServingRejected as e:
+                    # backpressure IS the API: 429 queue-full, 504 deadline
+                    METRICS.increment("serving.http.rejected")
+                    return self._json(e.status, {"error": str(e)})
+                except InjectedFault as e:
+                    return self._json(503, {"error": f"transient fault: {e}"})
+                except TimeoutError as e:
+                    return self._json(504, {"error": str(e)})
+                except (TypeError, ValueError, KeyError) as e:
+                    return self._json(400, {"error": str(e)})
+                except (FileNotFoundError, RuntimeError) as e:
+                    return self._json(409, {"error": str(e)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ handlers
+    def _generate(self, p: dict) -> dict:
+        if self.engine is None:
+            raise ValueError("no InferenceEngine mounted on this server")
+        if "prompt" not in p:
+            raise ValueError("missing required field 'prompt'")
+        eos = p.get("eos_id")
+        dl = p.get("deadline_ms")
+        comp = self.engine.generate(
+            p["prompt"], int(p.get("max_new_tokens", 16)),
+            temperature=float(p.get("temperature", 0.0)),
+            seed=int(p.get("seed", 0)),
+            eos_id=int(eos) if eos is not None else None,
+            deadline_ms=float(dl) if dl is not None else None,
+            timeout=self.request_timeout_s)
+        return {"tokens": comp.tokens, "finish_reason": comp.finish_reason,
+                "latency_s": comp.latency_s, "ttft_s": comp.ttft_s}
+
+    def _score(self, p: dict) -> dict:
+        if self.scorer is None:
+            raise ValueError("no BatchScorer mounted on this server")
+        if "inputs" not in p:
+            raise ValueError("missing required field 'inputs'")
+        xs = np.asarray(p["inputs"], np.float32)
+        if xs.ndim < 2:
+            raise ValueError("'inputs' must be a batch of rows")
+        ys = self.scorer.score_batch(xs, timeout=self.request_timeout_s)
+        return {"outputs": ys.tolist()}
+
+    def _reload(self) -> dict:
+        if self.engine is None:
+            raise ValueError("no InferenceEngine mounted on this server")
+        return {"step": self.engine.reload()}
+
+    def _health(self) -> dict:
+        out = {"ok": True}
+        if self.engine is not None:
+            out["engine"] = self.engine.stats()
+        if self.scorer is not None:
+            out["scorer"] = {"queue_depth": self.scorer._queue.depth()}
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "ModelServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="serving-http")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._server.server_close()
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

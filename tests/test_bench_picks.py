@@ -1,0 +1,61 @@
+"""Guards on bench.py's evidence-based config pickers: a 0.0-throughput
+row is EVIDENCE of a broken config (not missing data), and a winner must
+clear a >2% margin so one noisy TUNE row can't flip the headline config
+on measurement jitter."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import bench  # noqa: E402
+
+OK_CHECK = {"max_err": 0.001}
+
+
+def _att_rows(ring, flash, check=OK_CHECK):
+    rows = []
+    if check is not None:
+        rows.append({"flash_check": check})
+    if ring is not None:
+        rows.append({"attention": "ring", "batch": 64, "tokens_per_sec": ring})
+    if flash is not None:
+        rows.append({"attention": "flash", "batch": 64,
+                     "tokens_per_sec": flash})
+    return rows
+
+
+def test_pick_attention_needs_margin_not_just_a_win():
+    choice, reason = bench._pick_attention(_att_rows(100.0, 101.0))
+    assert choice == "ring"                      # 1% is inside jitter
+    choice, reason = bench._pick_attention(_att_rows(100.0, 103.0))
+    assert choice == "flash" and "TUNE" in reason
+
+
+def test_pick_attention_treats_zero_throughput_as_evidence():
+    # flash measured at 0.0 tok/s: a broken config, not a missing row —
+    # it must participate in the comparison and lose, not be skipped
+    assert bench._pick_attention(_att_rows(100.0, 0.0))[0] == "ring"
+    # no ring evidence at all -> conservative default, never flash-by-void
+    assert bench._pick_attention(_att_rows(None, 103.0))[0] == "ring"
+    # correctness battery failed -> speed win is irrelevant
+    bad = {"max_err": 0.2}
+    assert bench._pick_attention(_att_rows(100.0, 103.0, bad))[0] == "ring"
+
+
+def _bn_rows(off, on):
+    rows = []
+    if off is not None:
+        rows.append({"bn_fold": False, "batch": 256, "mfu": off})
+    if on is not None:
+        rows.append({"bn_fold": True, "batch": 256, "mfu": on})
+    return rows
+
+
+def test_pick_bn_fold_margin_and_missing_evidence():
+    assert bench._pick_bn_fold(_bn_rows(0.30, 0.303))[0] is False  # ~1%
+    on, reason = bench._pick_bn_fold(_bn_rows(0.30, 0.31))
+    assert on is True and "TUNE" in reason
+    assert bench._pick_bn_fold(_bn_rows(None, 0.31))[0] is False
+    assert bench._pick_bn_fold(_bn_rows(0.30, None))[0] is False
+    assert bench._pick_bn_fold(_bn_rows(0.30, 0.0))[0] is False

@@ -260,3 +260,41 @@ def test_state_spec_mirrors_params():
     # chain(scale_by_adam, add_decayed_weights, scale_by_schedule)
     assert spec[0] == (ps, ps)
     assert spec[1] == () and spec[2] == ()
+
+
+def test_decay_mask_override_is_context_local():
+    """The override stack is a ContextVar, not module state: concurrent
+    threads see only their own override, and the main context keeps the
+    ndim >= 2 heuristic while workers hold overrides open."""
+    import threading
+
+    params = {"w": jnp.ones((2, 2)), "b": jnp.ones((2,))}
+    default = tfm.decay_leaf_mask(params)
+    assert default == {"w": True, "b": False}
+
+    results = {}
+    barrier = threading.Barrier(3, timeout=10)
+
+    def worker(name, mask):
+        with tfm.decay_mask_override(mask):
+            barrier.wait()           # every context holds its override open
+            results[name] = tfm.decay_leaf_mask(params)
+
+    masks = {"a": {"w": False, "b": True}, "b": {"w": True, "b": True}}
+    threads = [threading.Thread(target=worker, args=(n, m))
+               for n, m in masks.items()]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    main_view = tfm.decay_leaf_mask(params)      # no override HERE
+    for t in threads:
+        t.join()
+    assert results == masks
+    assert main_view == default
+
+    # nesting: innermost wins, None re-enables the heuristic, exit restores
+    with tfm.decay_mask_override({"w": False, "b": False}):
+        with tfm.decay_mask_override(None):
+            assert tfm.decay_leaf_mask(params) == default
+        assert tfm.decay_leaf_mask(params) == {"w": False, "b": False}
+    assert tfm.decay_leaf_mask(params) == default

@@ -212,3 +212,28 @@ def test_cli_provision_kill_dry_run_executes_nothing(tmp_path, monkeypatch):
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
     assert p.returncode == 0, p.stderr[-800:]
     assert "delete" in p.stdout and not log.exists()
+
+
+def test_apply_timeout_names_step_and_keeps_audit_trail(tmp_path, monkeypatch):
+    """A hung gcloud must surface as a RuntimeError naming the step, with
+    the records-so-far attached — a half-created slice keeps its audit
+    trail so the caller can tear down exactly what was attempted."""
+    import pytest
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    stub = bindir / "gcloud"
+    stub.write_text("#!/usr/bin/env bash\nsleep 5\n")
+    stub.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    prov = PodSliceProvisioner(PodSliceSpec(
+        name="s8", accelerator_type="v5litepod-8", zone="us-west4-a"))
+    with pytest.raises(RuntimeError, match="'create' timed out") as ei:
+        prov.apply("https://example.com/r.git", "-m deeplearning4j_tpu train",
+                   dry_run=False, timeout_s=0.3)
+    err = ei.value
+    assert isinstance(err.__cause__, subprocess.TimeoutExpired)
+    assert [r["step"] for r in err.records] == ["create"]
+    assert err.records[0]["rc"] is None          # never finished
+    assert "teardown" in str(err)

@@ -1,0 +1,356 @@
+"""Serving-subsystem tests (DESIGN.md §13): continuous-batching parity
+with the offline sampler, admission control, hot reload, slot lifecycle,
+compile-cache discipline, and the HTTP surface.
+
+The acceptance contract is token-level: a request served through the
+slot-pool engine must produce EXACTLY the tokens
+``Transformer.sample(..., key=jax.random.key(seed), kv_cache=True)``
+produces for the same (prompt, max_new, temperature, seed) — continuous
+batching is an implementation detail, not a semantics change.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer import TransformerConfig, TransformerLM
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+from deeplearning4j_tpu.serving import (BatchScorer, InferenceEngine,
+                                        ModelServer, QueueFull, RequestQueue,
+                                        ServingClient, ServingConfig,
+                                        ServingError)
+from deeplearning4j_tpu.serving.batcher import DeadlineExceeded, GenerateRequest
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("dtype", jnp.float32)  # exact parity comparisons
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Untrained tiny LM — parity only needs determinism, not quality."""
+    cfg = tiny_cfg()
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.key(7))
+
+
+@pytest.fixture(scope="module")
+def cycle_lm():
+    """The test_transformer.py trained-cycle idiom: a model that greedily
+    continues a periodic stream, so EOS/reload tests can assert exact
+    token content, not just shapes."""
+    from deeplearning4j_tpu.optimize import transforms as T
+
+    period = [3, 1, 4, 1, 5, 9, 2, 6]
+    cfg = tiny_cfg(vocab_size=16, causal=True)
+    stream = np.array(period * 32, np.int32)
+    span = cfg.max_len + 1
+    n = len(stream) // span
+    blocks = stream[:n * span].reshape(n, span)
+    tokens = jnp.asarray(blocks[:, :-1])
+    targets = jnp.asarray(blocks[:, 1:])
+    model = TransformerLM(cfg)
+    tx = T.adamw(0.01)
+    params = model.init(jax.random.key(0))
+    opt = model.init_opt(params, tx)
+    step = model.build_train_step(tx)
+    for _ in range(60):
+        params, opt, _ = step(params, opt, tokens, targets)
+    # fixture sanity: the offline sampler continues the cycle
+    out = model.sample(params, period[:4], length=8, temperature=0.0)
+    assert out == (period * 3)[:len(out)]
+    return model, params, period
+
+
+def _expected(model, params, prompt, n, temp, seed):
+    return model.sample(params, prompt, n, temperature=temp,
+                        key=jax.random.key(seed),
+                        kv_cache=True)[len(prompt):]
+
+
+# --------------------------------------------------------------- admission
+def test_queue_backpressure_and_deadline():
+    q = RequestQueue(max_depth=2, max_batch_delay_ms=0.0)
+
+    def req(**kw):
+        return GenerateRequest(prompt=[1], max_new_tokens=1, **kw)
+
+    a, b = q.submit(req()), q.submit(req())
+    with pytest.raises(QueueFull) as ei:
+        q.submit(req())
+    assert ei.value.status == 429
+    assert q.take(8) == [a, b]      # FIFO, rejection freed no slot
+
+    # a request whose deadline expired while queued never reaches a slot
+    p = q.submit(req(deadline_s=time.monotonic() - 1.0))
+    assert q.take(8) == []
+    assert p.done()
+    with pytest.raises(DeadlineExceeded) as ei:
+        p.result(0)
+    assert ei.value.status == 504
+
+    counters = METRICS.snapshot()["counters"]
+    assert counters["serving.rejected"] == 1
+    assert counters["serving.deadline_dropped"] == 1
+
+
+def test_submit_validation_and_engine_backpressure(lm):
+    model, params = lm
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=1, max_queue=2))
+    with pytest.raises(ValueError, match="empty"):
+        engine.submit([], 4)
+    with pytest.raises(ValueError, match="out of range"):
+        engine.submit([999], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        engine.submit([1], 0)
+    with pytest.raises(ValueError, match="max_len"):
+        engine.submit([1] * 10, 30)
+    engine.submit([1], 2)
+    engine.submit([2], 2)
+    with pytest.raises(QueueFull):   # engine not started: queue fills
+        engine.submit([3], 2)
+    engine.stop()                    # fails the two queued handles
+
+
+# ----------------------------------------------------- continuous batching
+def test_continuous_batching_matches_offline_sample(lm):
+    """The acceptance test: mixed greedy/temperature traffic through 3
+    concurrent slots is token-identical to the sequential sampler."""
+    model, params = lm
+    plans = [([5, 1, 4], 6, 0.0, 0),
+             ([2, 8, 2, 8, 2, 8, 2, 8, 2], 4, 0.8, 123),
+             ([7], 5, 0.0, 3),
+             ([3, 2, 1, 0, 5], 6, 1.0, 9),
+             ([11, 12], 3, 0.8, 77)]
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in plans]
+
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=3, resolve_every=2))
+    # submit everything BEFORE the loop starts, so the first device batch
+    # is provably full (3/3 slots decoding concurrently)
+    handles = [engine.submit(p, n, temperature=t, seed=s)
+               for p, n, t, s in plans]
+    with engine:
+        outs = [h.result(60.0) for h in handles]
+
+    assert [o.tokens for o in outs] == want
+    assert all(o.finish_reason == "length" for o in outs)
+    assert all(o.ttft_s is not None and o.latency_s > 0 for o in outs)
+    snap = METRICS.snapshot()
+    assert snap["timers"]["serving.batch_fill_ratio"]["max_s"] == 1.0
+    assert snap["counters"]["serving.completed"] == len(plans)
+    assert snap["counters"]["serving.tokens"] == sum(len(w) for w in want)
+
+
+def test_prefill_recompiles_bounded_by_bucket_count(lm):
+    """PR-2 discipline: prompt lengths hash to a power-of-two bucket
+    ladder, so recompile count == bucket count, not prompt-length count."""
+    model, params = lm
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    with engine:   # warmup compiled the smallest bucket (8)
+        assert METRICS.snapshot()["counters"]["serving.prefill.recompile"] == 1
+        for p_len in (3, 5, 8):          # all land in bucket 8: no compiles
+            engine.generate([1] * p_len, 2)
+        assert METRICS.snapshot()["counters"]["serving.prefill.recompile"] == 1
+        for p_len in (9, 12, 16):        # all land in bucket 16: ONE compile
+            engine.generate([1] * p_len, 2)
+        assert METRICS.snapshot()["counters"]["serving.prefill.recompile"] == 2
+        assert engine.stats()["prefill_buckets"] == [8, 16]
+
+
+def test_eos_evicts_slot_and_reuses_it(cycle_lm):
+    """4 requests through 2 slots, each finishing on EOS long before its
+    length budget — completion requires eviction AND slot reuse."""
+    model, params, period = cycle_lm
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    with engine:
+        handles = [engine.submit(period[:4], 8, eos_id=9, seed=i)
+                   for i in range(4)]
+        outs = [h.result(60.0) for h in handles]
+    # greedy continuation is 5 9 2 6 ... -> stops at the injected EOS id 9
+    assert all(o.tokens == [5, 9] for o in outs)
+    assert all(o.finish_reason == "eos" for o in outs)
+    st = engine.stats()
+    assert st["admitted"] == 4 and st["completed"] == 4
+    assert st["active"] == 0 and st["free"] == 2
+    assert METRICS.snapshot()["counters"]["serving.completed"] == 4
+
+
+# -------------------------------------------------------------- hot reload
+def test_hot_reload_mid_traffic(cycle_lm, tmp_path):
+    """Swap to a newer checkpoint WITHOUT draining: the in-flight request
+    still completes, and post-reload traffic decodes with the new params."""
+    model, trained, period = cycle_lm
+    rand = model.init(jax.random.key(99))
+    ckdir = tmp_path / "ck"
+    mgr = CheckpointManager(ckdir, keep=5)
+    mgr.save(1, rand)
+
+    engine = InferenceEngine(model, checkpoint=str(ckdir),
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    assert engine.stats()["loaded_step"] == 1
+    with engine:
+        inflight = engine.submit(period[:4], 24)      # long, likely mid-decode
+        mgr.save(2, trained)
+        assert engine.reload() == 2
+        out = inflight.result(60.0)
+        assert out.finish_reason == "length" and len(out.tokens) == 24
+        post = engine.generate(period[:4], 8)
+        assert post.tokens == (period * 2)[4:12]      # trained-cycle greedy
+    snap = METRICS.snapshot()
+    assert snap["counters"]["serving.reloads"] == 1
+    assert snap["gauges"]["serving.loaded_step"] == 2
+    assert engine.reload() == 2                        # same step: no-op
+    assert METRICS.snapshot()["counters"]["serving.reloads"] == 1
+
+
+def test_checkpoint_read_only_serving_path(lm, tmp_path):
+    model, _ = lm
+    with pytest.raises(FileNotFoundError):
+        CheckpointManager.open_read_only(tmp_path / "missing")
+    ckdir = tmp_path / "ck"
+    mgr = CheckpointManager(ckdir, keep=2)
+    with pytest.raises(FileNotFoundError, match="no verified checkpoint"):
+        InferenceEngine(model, checkpoint=str(ckdir))  # dir exists, no ckpt
+    mgr.save(1, {"w": np.zeros(3, np.float32)})
+    ro = CheckpointManager.open_read_only(ckdir)
+    assert ro.latest_valid_step() == 1
+    with pytest.raises(RuntimeError, match="read-only"):
+        ro.save(2, {"w": np.zeros(3, np.float32)})
+
+
+# ------------------------------------------------------------ chaos sites
+def test_chaos_sites_fixed_plan(lm):
+    """Deterministic twin of tools/chaos_smoke.py's serving leg: a decode
+    fault skips the dispatch (state untouched -> tokens unchanged), a
+    submit fault raises to the caller and a retry wins."""
+    from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+    from deeplearning4j_tpu.resilience.faults import FAULTS, InjectedFault
+
+    model, params = lm
+    plans = [([4, 2], 5, 0.0, 0), ([1, 2, 3], 4, 0.8, 5), ([9], 3, 0.0, 1)]
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in plans]
+    specs = [FaultSpec("serving.decode", probability=1.0, max_fires=2),
+             FaultSpec("serving.request", at_step=2)]
+    retried = 0
+    with inject_faults(*specs, seed=0):
+        engine = InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=2, resolve_every=2)).start()
+        handles = []
+        for p, n, t, s in plans:
+            try:
+                handles.append(engine.submit(p, n, temperature=t, seed=s))
+            except InjectedFault:
+                retried += 1
+                handles.append(engine.submit(p, n, temperature=t, seed=s))
+        outs = [h.result(60.0) for h in handles]
+        engine.stop()
+        assert FAULTS.fire_count("serving.decode") == 2
+        assert FAULTS.fire_count("serving.request") == 1
+    assert retried == 1
+    assert [o.tokens for o in outs] == want
+    assert METRICS.snapshot()["counters"]["serving.decode.faults"] == 2
+
+
+# ------------------------------------------------------------ batch scorer
+def test_batch_scorer_coalesces_and_matches_direct():
+    calls = []
+    w = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+    def fn(xs):
+        calls.append(np.asarray(xs).shape[0])
+        return np.asarray(xs) @ w
+
+    xs = np.random.default_rng(0).normal(size=(6, 4)).astype(np.float32)
+    with BatchScorer(fn, max_batch=8) as sc:
+        np.testing.assert_allclose(sc.score_batch(xs), xs @ w, rtol=1e-6)
+        np.testing.assert_allclose(sc.score(xs[0]), xs[0] @ w, rtol=1e-6)
+        with pytest.raises(ValueError, match="row shape"):
+            sc.submit(np.zeros((5,), np.float32))
+    assert calls and all(c & (c - 1) == 0 for c in calls)  # pow2 buckets only
+    counters = METRICS.snapshot()["counters"]
+    assert counters["serving.score.rows"] == 7
+    assert counters["serving.score.recompile"] == len(set(calls))
+
+
+def test_batch_scorer_serves_multilayer_network():
+    """The zoo/MultiLayerNetwork half of the serving story: ``net.output``
+    drops into the scorer as-is."""
+    from deeplearning4j_tpu.nn import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import (NeuralNetConfiguration,
+                                            OptimizationAlgorithm,
+                                            list_builder)
+
+    base = NeuralNetConfiguration(
+        n_in=4, n_out=3, lr=0.1, momentum=0.9, use_adagrad=True,
+        num_iterations=1,
+        optimization_algo=OptimizationAlgorithm.ITERATION_GRADIENT_DESCENT,
+        activation="tanh")
+    conf = (list_builder(base, 2)
+            .hidden_layer_sizes(8)
+            .override(1, kind="output", activation="softmax", loss="mcxent")
+            .pretrain(False)
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    x = np.random.default_rng(1).normal(size=(5, 4)).astype(np.float32)
+    direct = np.asarray(net.output(x))
+    with BatchScorer(net.output, max_batch=8) as sc:
+        served = sc.score_batch(x)
+    np.testing.assert_allclose(served, direct, rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------------------------- HTTP layer
+def test_http_server_end_to_end(lm):
+    model, params = lm
+    w = np.arange(8, dtype=np.float32).reshape(4, 2)
+
+    def score_fn(xs):
+        return np.asarray(xs, np.float32) @ w
+
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    scorer = BatchScorer(score_fn, max_batch=8)
+    with engine, scorer, ModelServer(engine=engine, scorer=scorer) as server:
+        client = ServingClient(port=server.port)
+        prompt, n, seed = [5, 1, 4], 6, 11
+        want = _expected(model, params, prompt, n, 0.8, seed)
+        out = client.generate(prompt, max_new_tokens=n, temperature=0.8,
+                              seed=seed)
+        assert out["tokens"] == want and out["finish_reason"] == "length"
+
+        rows = [[1.0, 2.0, 3.0, 4.0], [0.0, -1.0, 0.5, 2.0]]
+        np.testing.assert_allclose(np.asarray(client.score(rows)),
+                                   np.asarray(rows, np.float32) @ w,
+                                   rtol=1e-6)
+        health = client.healthz()
+        assert health["ok"] and health["engine"]["slots"] == 2
+        prom = client.metrics_prom()
+        assert "serving_request_latency_seconds" in prom
+        assert "serving_tokens_total" in prom
+
+        with pytest.raises(ServingError) as e400:
+            client._json("/v1/generate", {"max_new_tokens": 2})  # no prompt
+        assert e400.value.status == 400
+        with pytest.raises(ServingError) as e409:
+            client.reload()                      # no checkpoint attached
+        assert e409.value.status == 409
+        with pytest.raises(ServingError) as e404:
+            client._json("/v1/nope", {})
+        assert e404.value.status == 404

@@ -283,6 +283,33 @@ def test_chunked_xent_matches_unchunked():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+def test_chunked_xent_pads_awkward_token_counts():
+    """A near-prime token count must NOT collapse the divisor search into
+    tiny chunks or fall back to full (B*T, V) logits: the stream is padded
+    with zero-weight tokens to a multiple of the configured chunk, and
+    loss AND grads still match the unchunked path (pad rows contribute
+    exactly 0 to the sum and 0 cotangent to every param)."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models.transformer import lm_head_loss
+
+    cfg = tiny_cfg(vocab_size=128, max_len=64, xent_chunk=16)
+    cfg0 = dataclasses.replace(cfg, xent_chunk=0)
+    params = init_params(jax.random.key(0), cfg)
+    # B*T = 61 (prime): largest divisor <= 16 is 1, so the pad path runs
+    h = jax.random.normal(jax.random.key(1), (1, 61, 32))
+    targets = jax.random.randint(jax.random.key(2), (1, 61), 0, 128)
+
+    l_chunk = lm_head_loss(params, h, targets, cfg)
+    l_full = lm_head_loss(params, h, targets, cfg0)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-6)
+
+    g_chunk = jax.grad(lambda p: lm_head_loss(p, h, targets, cfg))(params)
+    g_full = jax.grad(lambda p: lm_head_loss(p, h, targets, cfg0))(params)
+    for a, b in zip(jax.tree.leaves(g_chunk), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
 def test_zero1_step_matches_replicated_step():
     """ZeRO-1 weight-update sharding (reduce-scatter grads, dp-sharded
     optimizer state, all-gather params) computes the SAME training math as

@@ -8,9 +8,15 @@ recovery contract of DESIGN.md §12.  The seed is printed in the JSON
 result line, so any failing draw is replayable with
 ``python tools/chaos_smoke.py --seed N``.
 
-The deterministic tier-1 subset lives in ``tests/test_resilience.py``
-(fixed plans, per-mechanism assertions); this tool exists to keep rolling
-the dice on plan *combinations* nobody hand-picked.
+A second leg (``run_serving``) points the same dice at the serving
+subsystem: ``serving.request`` submission faults and ``serving.decode``
+dispatch skips, asserting completions stay token-identical to the
+fault-free ``Transformer.sample`` reference.
+
+The deterministic tier-1 subset lives in ``tests/test_resilience.py`` and
+``tests/test_serving.py`` (fixed plans, per-mechanism assertions); this
+tool exists to keep rolling the dice on plan *combinations* nobody
+hand-picked.
 """
 
 from __future__ import annotations
@@ -134,9 +140,86 @@ def run(seed: int | None = None) -> dict:
     return result
 
 
+def run_serving(seed: int) -> dict:
+    """Chaos leg for the serving subsystem: fire ``serving.request`` at a
+    random submit index and ``serving.decode`` for a random number of
+    decode rounds, and assert every completion is STILL token-identical
+    to the fault-free ``Transformer.sample`` reference — the engine's
+    skip-and-retry contract (a skipped dispatch leaves state untouched)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+    from deeplearning4j_tpu.resilience.faults import FAULTS, InjectedFault
+    from deeplearning4j_tpu.serving import InferenceEngine, ServingConfig
+
+    rng = random.Random(seed + 1)
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=32, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(11))
+    reqs = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                         for _ in range(rng.randint(1, 10))],
+                 max_new_tokens=rng.randint(1, 8),
+                 temperature=rng.choice([0.0, 0.8]),
+                 seed=rng.randrange(1 << 16))
+            for _ in range(5)]
+    expected = [model.sample(params, r["prompt"], r["max_new_tokens"],
+                             temperature=r["temperature"],
+                             key=jax.random.key(r["seed"]),
+                             kv_cache=True)[len(r["prompt"]):]
+                for r in reqs]
+
+    decode_fires = rng.randint(1, 3)
+    submit_fire_at = rng.randint(1, len(reqs))
+    specs = [FaultSpec("serving.decode", probability=1.0,
+                       max_fires=decode_fires),
+             FaultSpec("serving.request", at_step=submit_fire_at)]
+    submit_faults = 0
+    with inject_faults(*specs, seed=seed):
+        engine = InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=3, resolve_every=2)).start()
+        handles = []
+        for r in reqs:
+            try:
+                handles.append(engine.submit(**r))
+            except InjectedFault:
+                submit_faults += 1
+                handles.append(engine.submit(**r))   # transient: retry wins
+        outs = [h.result(60.0) for h in handles]
+        engine.stop()
+        fired = {"serving.decode": FAULTS.fire_count("serving.decode"),
+                 "serving.request": FAULTS.fire_count("serving.request")}
+
+    parity = all(o.tokens == e for o, e in zip(outs, expected))
+    result = {
+        "seed": seed,
+        "requests": len(reqs),
+        "token_parity_under_faults": parity,
+        "decode_faults_fired": fired["serving.decode"],
+        "submit_faults_fired": fired["serving.request"],
+        "submit_retries": submit_faults,
+    }
+    assert parity, f"seed {seed}: served tokens diverged under injection"
+    assert fired["serving.decode"] == decode_fires, result
+    assert fired["serving.request"] == 1 and submit_faults == 1, result
+    return result
+
+
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
-    print(json.dumps(run(seed)))
+    result = run(seed)
+    result["serving"] = run_serving(result["seed"])
+    print(json.dumps(result))
     return 0
 
 

@@ -1,0 +1,150 @@
+"""Serving load generator: drive the full HTTP stack, report latency + fill.
+
+Spins up a tiny random transformer, an :class:`InferenceEngine`, a
+:class:`BatchScorer` and a :class:`ModelServer` on a free port, then fires
+``--requests`` generations from ``--threads`` concurrent clients (random
+prompt lengths/temperatures/budgets from ``--seed``).  Everything observable
+flows through the PR-1 metrics registry — the JSON result line reports
+p50/p99 request latency and queue wait, time-to-first-token, batch fill
+ratio and tokens/sec exactly as a Prometheus scrape of ``/metrics.prom``
+would see them, so this doubles as an end-to-end check that the serving
+histograms land.
+
+    python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
+
+Exits nonzero if any request fails or the registry is missing a serving
+histogram.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+
+
+def run(requests: int = 32, threads: int = 4, seed: int = 0) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.serving import (BatchScorer, InferenceEngine,
+                                            ModelServer, ServingClient,
+                                            ServingConfig, ServingError)
+
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+
+    def score_fn(x):
+        # any row-wise fn serves; use the LM's own forward as the scorer
+        return model.forward(params, jnp.asarray(x, jnp.int32))[:, -1, :]
+
+    rng = random.Random(seed)
+    failures: list[str] = []
+    statuses: list[int] = []
+    lock = threading.Lock()
+
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=4, resolve_every=4))
+    scorer = BatchScorer(score_fn, max_batch=16)
+    with engine, scorer, ModelServer(engine=engine, scorer=scorer) as server:
+        client = ServingClient(port=server.port)
+        plans = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                              for _ in range(rng.randint(1, 12))],
+                      max_new_tokens=rng.randint(1, 10),
+                      temperature=rng.choice([0.0, 0.7, 1.0]),
+                      seed=rng.randrange(1 << 20))
+                 for _ in range(requests)]
+
+        def worker(mine):
+            for plan in mine:
+                try:
+                    out = client.generate(**plan)
+                    with lock:
+                        statuses.append(200)
+                    if len(out["tokens"]) > plan["max_new_tokens"]:
+                        with lock:
+                            failures.append(f"overlong answer for {plan}")
+                except ServingError as e:
+                    with lock:
+                        statuses.append(e.status)
+                        failures.append(str(e))
+
+        ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # one scorer round-trip through HTTP as well
+        rows = [[rng.randrange(cfg.vocab_size) for _ in range(4)]
+                for _ in range(6)]
+        outputs = client.score(rows)
+        if len(outputs) != len(rows):
+            failures.append("score row count mismatch")
+        health = client.healthz()
+        prom = client.metrics_prom()
+
+    snap = METRICS.snapshot()
+    timers, gauges = snap["timers"], snap["gauges"]
+
+    def pct(name):
+        t = timers.get(name)
+        return {"p50": t["p50_s"], "p99": t["p99_s"], "count": t["count"],
+                "mean": t["mean_s"]} if t else None
+
+    required = ["serving.request_latency", "serving.queue_wait",
+                "serving.ttft", "serving.batch_fill_ratio",
+                "serving.decode_step"]
+    missing = [n for n in required
+               if n not in timers
+               or n.replace(".", "_") + "_seconds" not in prom]
+    result = {
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "completed": statuses.count(200),
+        "rejected": len(statuses) - statuses.count(200),
+        "request_latency_s": pct("serving.request_latency"),
+        "queue_wait_s": pct("serving.queue_wait"),
+        "ttft_s": pct("serving.ttft"),
+        "batch_fill_ratio": pct("serving.batch_fill_ratio"),
+        "tokens_per_sec": gauges.get("serving.tokens_per_sec"),
+        "tokens_total": snap["counters"].get("serving.tokens"),
+        "prefill_buckets": health["engine"]["prefill_buckets"],
+        "missing_histograms": missing,
+        "failures": failures[:5],
+    }
+    assert not failures, failures[:5]
+    assert not missing, f"registry missing serving histograms: {missing}"
+    assert result["completed"] == requests
+    return result
+
+
+def main(argv: list[str]) -> int:
+    def arg(flag, default, cast=int):
+        return cast(argv[argv.index(flag) + 1]) if flag in argv else default
+
+    print(json.dumps(run(requests=arg("--requests", 32),
+                         threads=arg("--threads", 4),
+                         seed=arg("--seed", 0))))
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+    import pathlib
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main(sys.argv[1:]))
