@@ -259,12 +259,22 @@ def _validity_checks(name, iter_times, flops_per_iter, peak):
     return problems, mfu
 
 
-def _tune_rows(path="TUNE_r05.jsonl"):
+def _tune_rows(path=None):
     """Rows from the on-chip tuning battery (tools/run_tpu_battery.sh), if
-    it has run; [] otherwise."""
+    it has run; [] otherwise.  With no explicit path the newest
+    ``TUNE_r*.jsonl`` next to this file wins (batteries are per-round
+    artifacts — a fresh round's evidence supersedes the last)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    if path is None:
+        import glob
+        batteries = sorted(glob.glob(os.path.join(here, "TUNE_r*.jsonl")))
+        if not batteries:
+            return []
+        full = batteries[-1]
+    else:
+        full = os.path.join(here, path)
     rows = []
     try:
-        full = os.path.join(os.path.dirname(os.path.abspath(__file__)), path)
         with open(full) as f:
             for line in f:
                 line = line.strip()
@@ -278,29 +288,57 @@ def _tune_rows(path="TUNE_r05.jsonl"):
     return rows
 
 
+def _generic_kernel_rows(rows):
+    """Adapt battery JSONL into the kernel registry's generic schema
+    (``{"kernel", "candidate", metric}`` / ``{"kernel", "candidate",
+    "check"}``).  Rows already carrying a "kernel" key pass through;
+    the legacy per-kind shapes (r05's ``flash_check`` and
+    ``attention``/``batch`` rows) are converted so old batteries keep
+    feeding the same auto-pick."""
+    out = []
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        if "kernel" in r:
+            out.append(r)
+        elif isinstance(r.get("flash_check"), dict):
+            out.append({"kernel": "attention", "candidate": "flash",
+                        "check": r["flash_check"]})
+        elif "attention" in r and r.get("batch") == 64:
+            out.append({"kernel": "attention", "candidate": r["attention"],
+                        **{k: v for k, v in r.items() if k != "attention"}})
+    return out
+
+
 def _pick_attention(rows):
-    """'flash' iff the battery proved the Pallas kernel correct on-chip
-    (flash_check errors < 0.05) AND faster than ring at the bench config —
-    evidence-based default so a battery run upgrades the headline without
-    a manual flip.  Returns (choice, reason)."""
-    checks = [r["flash_check"] for r in rows if isinstance(
-        r.get("flash_check"), dict)]
-    flash_ok = any(all(isinstance(v, (int, float)) and v < 0.05
-                       for v in c.values()) and c for c in checks)
-    def best(att):
-        ts = [r["tokens_per_sec"] for r in rows
-              if r.get("attention") == att and r.get("batch") == 64
-              and isinstance(r.get("tokens_per_sec"), (int, float))]
-        return max(ts) if ts else None
-    ring, flash = best("ring"), best("flash")
-    # `is not None` (a 0.0-tok/s row is EVIDENCE of a broken config, not
-    # missing data) and a >2% margin so one noisy TUNE row can't flip the
-    # headline config on measurement jitter
-    if (flash_ok and ring is not None and flash is not None
-            and flash > ring * 1.02):
-        return "flash", (f"TUNE: flash {flash:.0f} > ring {ring:.0f} tok/s "
-                         "(>2% margin) at batch 64, flash_check passed")
-    return "ring", "default (no on-chip evidence that flash wins by >2%)"
+    """The headline attention kernel via the registry's evidence-gated
+    auto-pick: a Pallas candidate ("flash", "fused") replaces ring only
+    with an on-chip correctness check inside its tolerances AND a >2%
+    throughput win over ring.  Returns (choice, reason)."""
+    from deeplearning4j_tpu.ops.pallas import registry as kernel_registry
+    pick = kernel_registry.autopick(
+        "attention", _generic_kernel_rows(rows), incumbent="ring")
+    return pick.choice, pick.reason
+
+
+def _pick_fused_ln(rows):
+    """True iff the battery proved the fused residual+LayerNorm kernel
+    correct and >2% faster than the unfused XLA seam.  (bool, reason)."""
+    from deeplearning4j_tpu.ops.pallas import registry as kernel_registry
+    pick = kernel_registry.autopick(
+        "layernorm_residual", _generic_kernel_rows(rows),
+        incumbent="unfused")
+    return pick.choice == "fused", pick.reason
+
+
+def _pick_xent(rows):
+    """LM-loss implementation: "blocked" (Pallas streaming xent) iff the
+    battery proved it correct and >2% faster than the remat'd scan.
+    Returns (choice, reason)."""
+    from deeplearning4j_tpu.ops.pallas import registry as kernel_registry
+    pick = kernel_registry.autopick(
+        "xent", _generic_kernel_rows(rows), incumbent="scan")
+    return pick.choice, pick.reason
 
 
 def _pick_bn_fold(rows):
@@ -334,10 +372,25 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
     attention_reason = f"BENCH_ATTENTION={attention}" if attention else None
     if attention is None:
         attention, attention_reason = _pick_attention(_tune_rows())
+    # same evidence chain for the two other trainable-path kernels:
+    # BENCH_FUSED_LN=0/1 and BENCH_XENT=scan/blocked override; otherwise
+    # the battery decides through the registry gate, defaults off/scan
+    env_ln = os.environ.get("BENCH_FUSED_LN")
+    if env_ln is not None:
+        fused_ln, fused_ln_reason = env_ln == "1", f"BENCH_FUSED_LN={env_ln}"
+    else:
+        fused_ln, fused_ln_reason = _pick_fused_ln(_tune_rows())
+    env_xe = os.environ.get("BENCH_XENT")
+    if env_xe is not None:
+        xent_impl, xent_reason = env_xe, f"BENCH_XENT={env_xe}"
+    else:
+        xent_impl, xent_reason = _pick_xent(_tune_rows())
     if not on_tpu:
         # the CPU smoke config always runs ring — say so rather than
         # reporting a TUNE-based choice the leg did not use
         attention, attention_reason = "ring", "cpu fallback (ring)"
+        fused_ln, fused_ln_reason = False, "cpu fallback (unfused)"
+        xent_impl, xent_reason = "scan", "cpu fallback (scan)"
     if on_tpu and conserve_hbm:
         # OOM retry path: remat + half batch (main() falls back here when
         # the full-size leg dies with RESOURCE_EXHAUSTED)
@@ -345,7 +398,8 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
                                 n_layers=12, d_ff=3072, max_len=seq,
                                 causal=False, dtype=jnp.bfloat16, remat=True,
-                                attention=attention)
+                                attention=attention, fused_ln=fused_ln,
+                                xent_impl=xent_impl)
     elif on_tpu:
         # remat off: BERT-base at this batch fits v5e HBM comfortably and
         # remat's recompute would burn ~1/3 more FLOPs for nothing.
@@ -353,7 +407,8 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         cfg = TransformerConfig(vocab_size=32768, d_model=768, n_heads=12,
                                 n_layers=12, d_ff=3072, max_len=seq,
                                 causal=False, dtype=jnp.bfloat16, remat=False,
-                                attention=attention)
+                                attention=attention, fused_ln=fused_ln,
+                                xent_impl=xent_impl)
     else:
         batch, seq, iters = 4, 128, 4
         cfg = TransformerConfig(vocab_size=1024, d_model=128, n_heads=4,
@@ -421,6 +476,8 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         "name": "bert_base", "iters": iters, "batch": batch, "seq": seq,
         "attention": cfg.attention,
         "attention_choice": attention_reason,
+        "fused_ln": cfg.fused_ln, "fused_ln_choice": fused_ln_reason,
+        "xent_impl": cfg.xent_impl, "xent_choice": xent_reason,
         "iter_times": iter_times, "stats": st,
         "e2e_stats": e2e, "prefetch_stats": pf,
         "async_dispatch_stats": _stats(al_times),
@@ -789,8 +846,48 @@ def _registry_timers():
             for name, summary in METRICS.snapshot()["timers"].items()}
 
 
+def _stale_guard(last_valid, allow_stale):
+    """Refuse to surface a stale TPU artifact as comparison evidence.
+
+    ``LAST_VALID_TPU_BENCH.json`` carries ``stale: true`` when its
+    numbers predate code changes that invalidate them (``asof_pr`` says
+    how far back).  A CPU fallback run must not quote those as the
+    most-recent evidence unless the operator explicitly passes
+    ``--allow-stale``."""
+    if not isinstance(last_valid, dict) or not last_valid.get("stale"):
+        return last_valid
+    if allow_stale:
+        return dict(last_valid, stale_comparison_allowed_by_flag=True)
+    return {
+        "refused_stale_comparison": last_valid.get("metric"),
+        "asof_pr": last_valid.get("asof_pr"),
+        "note": ("artifact is marked stale (predates current code) — "
+                 "rerun the TPU battery to refresh it, or pass "
+                 "--allow-stale to quote it anyway"),
+    }
+
+
+def _kernel_picks():
+    """The full auto-pick table for the artifact: one decision per kernel
+    kind, with every dropped candidate and its reason (no silent caps)."""
+    from deeplearning4j_tpu.ops.pallas import registry as kernel_registry
+    rows = _generic_kernel_rows(_tune_rows())
+    table = {}
+    for kind, incumbent in (("attention", "ring"),
+                            ("layernorm_residual", "unfused"),
+                            ("xent", "scan"),
+                            ("int8_matmul", "f32")):
+        try:
+            table[kind] = kernel_registry.autopick(
+                kind, rows, incumbent=incumbent).as_dict()
+        except Exception as e:                  # table is telemetry, not a leg
+            table[kind] = {"error": repr(e)[:200]}
+    return table
+
+
 def main():
     t_start = time.time()
+    allow_stale = "--allow-stale" in sys.argv
     # Persistent XLA compilation cache (repo-local, gitignored): the BERT
     # leg's compile dominates bench wall time on reruns; cache hits skip it.
     from deeplearning4j_tpu.parallel.compile_cache import setup_compile_cache
@@ -893,6 +990,7 @@ def main():
                 last_valid = json.load(f)
         except Exception:
             pass
+        last_valid = _stale_guard(last_valid, allow_stale)
 
     bst = bert["stats"]
     metric = ("bert_base_train_tokens_per_sec" if on_tpu
@@ -943,6 +1041,9 @@ def main():
         "word2vec": w2v,
         "decode": decode,
         "dp_machinery_check": scaling,
+        # which implementation each kernel kind would run in production
+        # and why, with every dropped candidate's reason on record
+        "kernel_picks": _kernel_picks(),
         **({"real_config_compile_check": real_compile} if real_compile else {}),
         "wall_s": round(time.time() - t_start, 1),
         # same raw observations the /metrics endpoint would serve during
@@ -982,7 +1083,9 @@ def main():
             path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "LAST_VALID_TPU_BENCH.json")
             with open(path, "w") as f:
-                json.dump(out, f)
+                # fresh on-chip evidence: not stale, stamped with the PR
+                # it measured so future stale-marking has a reference
+                json.dump(dict(out, stale=False, asof_pr=6), f)
                 f.write("\n")
         except OSError:
             pass

@@ -1,4 +1,4 @@
-"""The graftlint rule set — seven hazard classes from this repo's history.
+"""The graftlint rule set — eight hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -17,6 +17,8 @@
 | EXC01 | bare `except:` — catches SystemExit/KeyboardInterrupt, so a      |
 |       | retry/supervision loop becomes unkillable and every failure      |
 |       | signal is swallowed untyped                                      |
+| PL01  | `pallas_call` without an `interpret=` keyword — the kernel body  |
+|       | can only execute on TPU, so CPU tier-1 tests never run it        |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -593,3 +595,38 @@ class BareExceptRule(Rule):
                     "a retry loop built on this cannot be killed and treats "
                     "every failure as retryable; catch Exception (or the "
                     "policy's retry_on tuple) instead")
+
+
+@register
+class PallasInterpretRule(Rule):
+    """PL01 — ``pallas_call`` without an ``interpret`` fallback.
+
+    The kernel tier's contract (DESIGN.md §14) is that every Pallas
+    kernel runs its REAL body in tier-1 CPU tests via interpret mode —
+    a ``pl.pallas_call`` with no ``interpret=`` keyword can only ever
+    execute on a TPU, so its kernel body is dead code to the test suite
+    and every bug in it ships untested.  Wrappers must thread an
+    ``interpret`` flag (auto-selected off-TPU) down to the call.
+
+    Blind spot: a call aliased through a variable
+    (``f = pl.pallas_call; f(...)``) is not seen; none exist in-tree.
+    """
+
+    id = "PL01"
+    title = "pallas_call without interpret fallback"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = module.canonical(node.func) or dotted_name(node.func) or ""
+            if not name.endswith("pallas_call"):
+                continue
+            if any(kw.arg == "interpret" for kw in node.keywords):
+                continue
+            yield self.finding(
+                module, node,
+                "`pallas_call` without an `interpret=` keyword compiles "
+                "only on TPU — CPU tier-1 tests can never execute the "
+                "kernel body; thread an interpret flag (auto-selected "
+                "off-TPU) through the wrapper")

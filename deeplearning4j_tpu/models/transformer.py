@@ -65,9 +65,20 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16        # MXU compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True               # jax.checkpoint each block (HBM for FLOPs)
-    attention: str = "ring"          # "ring" (default) | "flash" (Pallas
-    #                                  kernel, single-shard only; opt-in
-    #                                  until benchmarked on a real chip)
+    attention: str = "ring"          # "ring" (default) | any registered
+    #                                  ops/pallas attention candidate
+    #                                  ("flash", "fused") — Pallas kernels
+    #                                  are single-shard only and opt-in
+    #                                  until the bench auto-pick adopts them
+    fused_ln: bool = False           # fuse the mid-block residual+LN seam
+    #                                  through ops/pallas/layernorm — one
+    #                                  VMEM pass instead of two HBM
+    #                                  round-trips; bench-gated opt-in
+    xent_impl: str = "scan"          # "scan" (chunked lax.scan, default) |
+    #                                  "blocked" (ops/pallas/xent streaming
+    #                                  kernel for ALL chunked cases; the
+    #                                  near-prime fallback always streams
+    #                                  through the blocked kernel)
     xent_chunk: int = 2048           # LM-loss token-chunk size; 0 disables.
     #                                  Full (B*T, V) f32 logits are the
     #                                  biggest HBM tensor in training (4.3 GB
@@ -270,7 +281,17 @@ def ring_attention(q, k, v, *, n_sp: int, sp_axis: str | None, causal: bool,
 def _ffn(lp, h, dt):
     """The FFN sublayer body on (..., D) activations — shared verbatim by
     the training ``_block`` and the incremental ``decode_step`` so the two
-    paths cannot silently diverge (tp boundaries stay with the caller)."""
+    paths cannot silently diverge (tp boundaries stay with the caller).
+
+    A serving tree quantized by ``quantize_params_for_decode`` carries
+    ``w1_q``/``w2_q`` (int8 + per-channel scales) instead of w1/w2; the
+    key check is static at trace time, so training trees compile exactly
+    the code they always did."""
+    if "w1_q" in lp:
+        from ..ops.pallas.matmul_int8 import int8_matmul
+        u = int8_matmul(h.astype(dt), lp["w1_q"]).astype(dt)
+        u = jax.nn.gelu(u + lp["b1"].astype(dt))
+        return int8_matmul(u, lp["w2_q"]).astype(dt)
     u = jnp.einsum("...d,df->...f", h.astype(dt), lp["w1"].astype(dt))
     u = jax.nn.gelu(u + lp["b1"].astype(dt))
     return jnp.einsum("...f,fd->...d", u, lp["w2"].astype(dt))
@@ -284,18 +305,29 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
         h = copy_to_tp(h, tp_axis)
     qkv = jnp.einsum("btd,dshe->btshe", h.astype(dt), params["wqkv"].astype(dt))
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if cfg.attention == "flash" and n_sp == 1 and t_local % 128 == 0:
-        from ..ops.flash_attention import flash_attention
-        attn = flash_attention(q, k, v, causal=cfg.causal)
+    if cfg.attention != "ring" and n_sp == 1 and t_local % 128 == 0:
+        # any registered ops/pallas attention candidate ("flash", "fused",
+        # ...) resolves through the kernel registry; ring keeps its direct
+        # path because it is the sp-aware collective, not a candidate here
+        from ..ops.pallas import registry as kernel_registry
+        attn = kernel_registry.get("attention", cfg.attention).fn(
+            q, k, v, causal=cfg.causal)
     else:
         attn = ring_attention(q, k, v, n_sp=n_sp, sp_axis=sp_axis,
                               causal=cfg.causal, t_local=t_local)
     proj = jnp.einsum("bthe,hed->btd", attn.astype(dt), params["wo"].astype(dt))
     if tp_axis:
         proj = reduce_from_tp(proj, tp_axis)  # partial sums over local heads
-    x = x + proj.astype(x.dtype)
-
-    h2 = _layernorm(x, params["ln2_scale"], params["ln2_bias"])
+    if cfg.fused_ln and not tp_axis:
+        # one VMEM pass for the mid-block residual-add + LayerNorm seam
+        # (bench-gated opt-in; under tp the unfused path keeps the
+        # copy_to_tp placement below untouched)
+        from ..ops.pallas.layernorm import fused_residual_layernorm
+        x, h2 = fused_residual_layernorm(
+            x, proj.astype(x.dtype), params["ln2_scale"], params["ln2_bias"])
+    else:
+        x = x + proj.astype(x.dtype)
+        h2 = _layernorm(x, params["ln2_scale"], params["ln2_bias"])
     if tp_axis:
         h2 = copy_to_tp(h2, tp_axis)
     down = _ffn(params, h2, dt)
@@ -349,21 +381,19 @@ def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
         div = chunk
         while n_tok % div:
             div -= 1
-        if div >= cfg.xent_chunk // 4:
+        if div >= cfg.xent_chunk // 4 and cfg.xent_impl != "blocked":
             chunk = div
         else:
-            # a near-prime token count drives the divisor search down to a
-            # tiny chunk — thousands of sequential (chunk, V) matmuls in
-            # the scan.  Materializing full (n_tok, V) logits instead is
-            # the exact OOM hazard this chunking exists to avoid, so: pad
-            # the token stream to a multiple of the CONFIGURED chunk with
-            # zero-WEIGHT pad tokens.  Pad rows contribute exactly 0 to
-            # the sum (and 0 cotangent to every param), and the mean still
-            # divides by the real token count.
-            pad = -n_tok % chunk
-            h_flat = jnp.concatenate([h_flat, jnp.zeros((pad, D), h_flat.dtype)])
-            t_flat = jnp.concatenate([t_flat, jnp.zeros((pad,), t_flat.dtype)])
-            w_flat = jnp.concatenate([w_flat, jnp.zeros((pad,), jnp.float32)])
+            # Two ways here: a near-prime token count drives the divisor
+            # search down to a tiny chunk (thousands of sequential
+            # (chunk, V) matmuls), or ``cfg.xent_impl="blocked"`` opted
+            # the whole chunked path in.  Either way the blocked-xent
+            # tier streams (N, V) tile-by-tile with internal zero-weight
+            # row padding — shape-independent, and on the pallas backend
+            # the logits never materialize at all.
+            from ..ops import losses
+            return losses.blocked_token_xent(
+                h_flat.astype(cfg.dtype), hd, t_flat) / n_tok
 
     if chunk and 1 < chunk < n_tok:
         body_fn = jax.checkpoint(token_xent)
@@ -432,6 +462,11 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
         down = _ffn(lp, h2, dt) + lp["b2"].astype(dt)
         x = x + down.astype(x.dtype)
     h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    if "head_q" in params:
+        # int8-quantized serving tree (quantize_params_for_decode): the
+        # LM head streams as int8 + per-channel scales, logits f32
+        from ..ops.pallas.matmul_int8 import int8_matmul
+        return int8_matmul(h.astype(dt), params["head_q"]), new_cache
     head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
     return (h.astype(dt) @ head.astype(dt)).astype(jnp.float32), new_cache
 

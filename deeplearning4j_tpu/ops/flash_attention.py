@@ -189,3 +189,28 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     out = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v),
                       causal, block_q, block_k, interpret)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------------------- registration
+
+def _naive_reference(q, k, v, *, causal: bool = True):
+    # call-time import: ops.pallas.attention imports _blockwise_bwd from
+    # this module, so a top-level import here would be circular
+    from .pallas.attention import reference_attention
+    return reference_attention(q, k, v, causal=causal)
+
+
+# flash predates the ops/pallas tier but competes through the SAME
+# candidate registry (one registration API — DESIGN.md §14); the public
+# flash_attention signature above is unchanged.
+from .pallas import registry as _kernel_registry  # noqa: E402
+
+_kernel_registry.register(_kernel_registry.KernelCandidate(
+    kind="attention", name="flash", fn=flash_attention,
+    reference=_naive_reference,
+    blocks=({"block_q": 128, "block_k": 128},
+            {"block_q": 256, "block_k": 128}),
+    # the on-chip battery's flash_check gate, unchanged: fwd/bwd max abs
+    # error vs naive attention must stay under 0.05
+    tolerances={"max_err": 0.05},
+))

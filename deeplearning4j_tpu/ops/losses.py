@@ -15,6 +15,7 @@ from __future__ import annotations
 import enum
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 
 _EPS = 1e-7
@@ -36,6 +37,9 @@ class LossFunction(str, enum.Enum):
     COSINE_PROXIMITY = "cosine_proximity"
     HINGE = "hinge"
     L1 = "l1"
+    BLOCKED_MCXENT = "blocked_mcxent"   # streaming xent — takes RAW logits
+    #                                     (or an (h, head) pair), not
+    #                                     softmax output; see blocked_mcxent
 
 
 def _clip(p):
@@ -94,6 +98,88 @@ def l1(labels, output):
     return jnp.mean(jnp.sum(jnp.abs(labels - output), axis=-1))
 
 
+# --------------------------------------------------------- blocked xent tier
+#
+# The streaming token cross entropy consumed by the transformer's
+# lm_head_loss.  Backend selection happens ONCE at import: the Pallas
+# blocked kernel when the wheel has a working jax.experimental.pallas,
+# else the zero-weight-padded scan fallback (same math, tile logits do
+# materialize) — never a per-call try/except on the hot path.
+
+try:
+    from .pallas.xent import blocked_cross_entropy as _BLOCKED_XENT_IMPL
+    BLOCKED_XENT_BACKEND = "pallas"
+except Exception:  # pragma: no cover - old wheel / broken pallas
+    _BLOCKED_XENT_IMPL = None
+    BLOCKED_XENT_BACKEND = "reference"
+
+
+def _blocked_xent_fallback(h, head, targets, weights=None, *,
+                           block_t: int = 256, **_):
+    """Scan over zero-weight-padded token tiles (the PR-5 near-prime
+    schedule, shape-generalized) — used only when Pallas is absent."""
+    from jax import lax
+
+    n, d = h.shape
+    block_t = min(block_t, n)
+    w = (jnp.ones((n,), jnp.float32) if weights is None
+         else weights.astype(jnp.float32))
+    t = targets
+    pad = -n % block_t
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        t = jnp.concatenate([t, jnp.zeros((pad,), t.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+
+    @jax.checkpoint
+    def tile(h_t, t_t, w_t):
+        logits = jnp.dot(h_t, head,
+                         preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), -1)
+        gold = jnp.take_along_axis(
+            logits.astype(jnp.float32), t_t[:, None], axis=-1)[:, 0]
+        return ((lse - gold) * w_t).sum()
+
+    def body(tot, xs):
+        return tot + tile(*xs), None
+
+    total, _ = lax.scan(
+        body, jnp.zeros((), jnp.float32),
+        (h.reshape(-1, block_t, d), t.reshape(-1, block_t),
+         w.reshape(-1, block_t)))
+    return total
+
+
+if _BLOCKED_XENT_IMPL is None:
+    _BLOCKED_XENT_IMPL = _blocked_xent_fallback
+
+
+def blocked_token_xent(h, head, targets, weights=None, **kw) -> jnp.ndarray:
+    """Weighted SUM of per-token cross entropy of (N, D) hiddens against
+    a (D, V) head, streamed tile-by-tile (full logits never materialize
+    on the pallas backend).  Shape-independent: any N/V.  The backend was
+    selected at import (``BLOCKED_XENT_BACKEND``)."""
+    return _BLOCKED_XENT_IMPL(h, head, targets, weights, **kw)
+
+
+def blocked_mcxent(labels, output):
+    """Dispatch-table face of the blocked xent tier.
+
+    Unlike every other entry, ``output`` is NOT softmax probabilities:
+    pass either raw logits (N, C) — computed stably from the lse — or an
+    ``(hiddens, head)`` tuple, in which case the selected streaming
+    backend runs and (N, V) logits never materialize.  ``labels`` are
+    one-hot rows either way; returns the mean over examples."""
+    if isinstance(output, tuple):
+        h, head = output
+        targets = jnp.argmax(labels, axis=-1)
+        return blocked_token_xent(h, head, targets) / labels.shape[0]
+    logits = output.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.sum(labels * logits, axis=-1)
+    return jnp.mean(lse - gold)
+
+
 _FNS: dict[LossFunction, Callable] = {
     LossFunction.MSE: mse,
     LossFunction.EXPLL: expll,
@@ -106,6 +192,7 @@ _FNS: dict[LossFunction, Callable] = {
     LossFunction.COSINE_PROXIMITY: cosine_proximity,
     LossFunction.HINGE: hinge,
     LossFunction.L1: l1,
+    LossFunction.BLOCKED_MCXENT: blocked_mcxent,
 }
 
 

@@ -69,6 +69,9 @@ class ServingConfig:
     min_prefill_bucket: int = 8     # floor of the prompt bucket ladder
     idle_wait_s: float = 0.05       # queue poll period while no slot is live
     default_eos_id: int | None = None
+    int8_decode: bool = False       # serve int8 weight-quantized FFN/head
+    #                                 (opt-in; adoption gated on token-level
+    #                                 top-1 agreement with f32 decode)
 
 
 @dataclasses.dataclass
@@ -116,7 +119,11 @@ class InferenceEngine:
             restored = self._ckpt.restore(template, step=step)
             params = restored["params"]
             self._loaded_step = restored["step"]
-        self._params = params
+        # _raw_params is the unquantized tree (also the reload restore
+        # template — checkpoints never contain *_q leaves); _params is
+        # what decode actually reads, int8-quantized when opted in
+        self._raw_params = params
+        self._params = self._maybe_quantize(params)
         self._state = self._init_state()
         self._step_fn = jax.jit(self._build_step(), donate_argnums=(1,))
         self._step_compiled = False
@@ -128,6 +135,17 @@ class InferenceEngine:
         self._lock = threading.Lock()   # guards _params swap vs. read
         self._admitted = 0
         self._completed = 0
+
+    def _maybe_quantize(self, params):
+        """The serving tree decode reads: unchanged by default; with
+        ``int8_decode`` the bandwidth-heavy matrices (FFN w1/w2, LM head)
+        are replaced by int8 + per-channel-scale copies, and
+        ``decode_step``/``_ffn`` pick the int8 path on key presence."""
+        if not self.cfg.int8_decode:
+            return params
+        from ..ops.pallas.matmul_int8 import quantize_params_for_decode
+        with allow_transfers(), METRICS.time("serving.quantize"):
+            return quantize_params_for_decode(params, self.model.cfg)
 
     # ------------------------------------------------------------ device state
     def _init_state(self) -> dict:
@@ -486,9 +504,11 @@ class InferenceEngine:
         if step == self._loaded_step:
             return step
         with allow_transfers(), METRICS.time("serving.reload"):
-            restored = self._ckpt.restore(self._params, step=step)
+            restored = self._ckpt.restore(self._raw_params, step=step)
+            new_params = self._maybe_quantize(restored["params"])
         with self._lock:
-            self._params = restored["params"]
+            self._raw_params = restored["params"]
+            self._params = new_params
         self._loaded_step = step
         METRICS.increment("serving.reloads")
         METRICS.gauge("serving.loaded_step", step)

@@ -59,3 +59,71 @@ def test_pick_bn_fold_margin_and_missing_evidence():
     assert bench._pick_bn_fold(_bn_rows(None, 0.31))[0] is False
     assert bench._pick_bn_fold(_bn_rows(0.30, None))[0] is False
     assert bench._pick_bn_fold(_bn_rows(0.30, 0.0))[0] is False
+
+
+def _kernel_rows(kind, cand, incumbent, cand_ts, inc_ts, check):
+    return [
+        {"kernel": kind, "candidate": cand, "check": check},
+        {"kernel": kind, "candidate": cand, "tokens_per_sec": cand_ts},
+        {"kernel": kind, "candidate": incumbent, "tokens_per_sec": inc_ts},
+    ]
+
+
+def test_pick_fused_ln_and_xent_follow_the_same_gate():
+    ok = {"max_err": 1e-5}
+    # margin respected
+    assert bench._pick_fused_ln(_kernel_rows(
+        "layernorm_residual", "fused", "unfused", 101.0, 100.0, ok))[0] is False
+    on, reason = bench._pick_fused_ln(_kernel_rows(
+        "layernorm_residual", "fused", "unfused", 110.0, 100.0, ok))
+    assert on is True and "TUNE" in reason
+    # failed correctness -> stays off regardless of speed
+    assert bench._pick_fused_ln(_kernel_rows(
+        "layernorm_residual", "fused", "unfused", 110.0, 100.0,
+        {"max_err": 0.5}))[0] is False
+    # xent picker: same chain, scan incumbent
+    assert bench._pick_xent([])[0] == "scan"
+    choice, reason = bench._pick_xent(_kernel_rows(
+        "xent", "blocked", "scan", 110.0, 100.0, ok))
+    assert choice == "blocked" and "TUNE" in reason
+
+
+def test_pick_attention_generic_rows_can_adopt_fused():
+    ok = {"max_err": 1e-4}
+    rows = _kernel_rows("attention", "fused", "ring", 110.0, 100.0, ok)
+    assert bench._pick_attention(rows)[0] == "fused"
+
+
+def test_stale_guard_refuses_unless_flagged():
+    artifact = {"metric": "bert_base_train_tokens_per_sec", "value": 87446.7,
+                "stale": True, "asof_pr": 0}
+    refused = bench._stale_guard(artifact, allow_stale=False)
+    assert "refused_stale_comparison" in refused
+    assert refused["asof_pr"] == 0
+    assert "value" not in refused            # numbers do not leak through
+    allowed = bench._stale_guard(artifact, allow_stale=True)
+    assert allowed["value"] == 87446.7
+    assert allowed["stale_comparison_allowed_by_flag"] is True
+    # fresh artifacts pass untouched
+    fresh = {"metric": "m", "value": 1.0, "stale": False}
+    assert bench._stale_guard(fresh, allow_stale=False) is fresh
+    assert bench._stale_guard(None, allow_stale=False) is None
+
+
+def test_committed_artifact_is_marked_stale():
+    # the checked-in TPU numbers predate the kernel tier: a CPU run must
+    # not quote them without --allow-stale
+    import json
+    from pathlib import Path
+    path = Path(bench.__file__).resolve().parent / "LAST_VALID_TPU_BENCH.json"
+    artifact = json.loads(path.read_text())
+    assert artifact["stale"] is True
+    assert "asof_pr" in artifact
+
+
+def test_kernel_picks_table_covers_every_kind():
+    table = bench._kernel_picks()
+    assert set(table) == {"attention", "layernorm_residual", "xent",
+                          "int8_matmul"}
+    for kind, pick in table.items():
+        assert "choice" in pick and "dropped" in pick, kind

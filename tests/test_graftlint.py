@@ -419,6 +419,33 @@ def test_exc01_quiet_on_typed_handlers():
     assert lint(src, only="EXC01") == []
 
 
+# --------------------------------------------------------------------------- PL01
+
+PL01_BAD = """
+    from jax.experimental import pallas as pl
+
+    def call(kernel, x, spec):
+        return pl.pallas_call(kernel, out_shape=x, in_specs=[spec])(x)
+"""
+
+
+def test_pl01_fires_on_pallas_call_without_interpret():
+    findings = [f for f in lint(PL01_BAD) if f.rule == "PL01"]
+    assert len(findings) == 1
+    assert "interpret" in findings[0].message
+
+
+def test_pl01_quiet_when_interpret_is_threaded():
+    src = """
+        from jax.experimental import pallas as pl
+
+        def call(kernel, x, spec, interpret):
+            return pl.pallas_call(kernel, out_shape=x, in_specs=[spec],
+                                  interpret=interpret)(x)
+    """
+    assert lint(src, only="PL01") == []
+
+
 # --------------------------------------------------------------------------- suppressions
 
 def test_same_line_pragma_suppresses_one_rule():

@@ -1,0 +1,385 @@
+"""Gradient-parity suite for the ops/pallas kernel tier.
+
+Every kernel candidate must match its pure-jnp reference forward AND
+backward, in Pallas interpret mode on CPU (the same code compiles to
+Mosaic on TPU), at odd/near-prime shapes and in both f32 and bf16 — plus
+unit coverage of the candidate registry and the evidence-gated auto-pick
+that decides what production runs.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.pallas import registry
+from deeplearning4j_tpu.ops.pallas.attention import (fused_attention,
+                                                     reference_attention)
+from deeplearning4j_tpu.ops.pallas.layernorm import (
+    fused_residual_layernorm, reference_residual_layernorm)
+from deeplearning4j_tpu.ops.pallas.matmul_int8 import (
+    dequantize, int8_matmul, quantize, quantize_params_for_decode,
+    reference_int8_matmul, top1_agreement)
+from deeplearning4j_tpu.ops.pallas.xent import (blocked_cross_entropy,
+                                                reference_xent_sum)
+
+F32_TOL = dict(atol=2e-5, rtol=3e-5)
+# bf16 inputs: reference and kernel round differently mid-pipeline
+BF16_TOL = dict(atol=3e-2, rtol=3e-2)
+
+
+def _tol(dtype):
+    return F32_TOL if dtype == jnp.float32 else BF16_TOL
+
+
+def _close(a, b, dtype):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), **_tol(dtype))
+
+
+# ------------------------------------------------------------------ registry
+
+def test_registry_kinds_and_candidates_complete():
+    assert registry.import_errors() == {}
+    assert registry.kinds() == ["attention", "int8_matmul",
+                                "layernorm_residual", "xent"]
+    assert [c.name for c in registry.candidates("attention")] == [
+        "flash", "fused", "ring"]
+    # every pallas candidate ships a reference and documented tolerances
+    for kind in registry.kinds():
+        for c in registry.candidates(kind):
+            assert c.reference is not None, (kind, c.name)
+            if c.source == "pallas":
+                assert c.tolerances, (kind, c.name)
+                assert c.blocks, (kind, c.name)
+
+
+def test_registry_get_unknown_lists_registered():
+    with pytest.raises(KeyError, match="flash"):
+        registry.get("attention", "nope")
+
+
+def test_registry_reregistration_same_fn_is_noop_different_fn_raises():
+    cand = registry.get("attention", "fused")
+    registry.register(cand)                       # idempotent
+    clash = dataclasses.replace(cand, fn=lambda *a, **k: None)
+    with pytest.raises(ValueError, match="already registered"):
+        registry.register(clash)
+
+
+# ------------------------------------------------------------------ autopick
+
+def _rows(kind, cand, metric_vals, check=None, incumbent=None, inc_vals=()):
+    rows = []
+    if check is not None:
+        rows.append({"kernel": kind, "candidate": cand, "check": check})
+    rows += [{"kernel": kind, "candidate": cand, "tokens_per_sec": v}
+             for v in metric_vals]
+    rows += [{"kernel": kind, "candidate": incumbent, "tokens_per_sec": v}
+             for v in inc_vals]
+    return rows
+
+
+def test_autopick_needs_margin_and_correctness():
+    ok = {"max_err": 1e-4}
+    win = registry.autopick("attention", _rows(
+        "attention", "fused", [103.0], ok, "ring", [100.0]), incumbent="ring")
+    assert win.choice == "fused" and "TUNE" in win.reason
+    # 1% is inside jitter -> incumbent, with the loser's reason on record
+    jit = registry.autopick("attention", _rows(
+        "attention", "fused", [101.0], ok, "ring", [100.0]), incumbent="ring")
+    assert jit.choice == "ring"
+    assert any(d["candidate"] == "fused" and "margin" in d["reason"]
+               for d in jit.dropped)
+    # failed correctness gate -> speed win is irrelevant
+    bad = registry.autopick("attention", _rows(
+        "attention", "fused", [200.0], {"max_err": 0.2}, "ring", [100.0]),
+        incumbent="ring")
+    assert bad.choice == "ring"
+    assert any("correctness" in d["reason"] for d in bad.dropped)
+
+
+def test_autopick_zero_throughput_and_void_are_evidence():
+    ok = {"max_err": 1e-4}
+    # 0.0 tok/s is a broken config, not missing data
+    zero = registry.autopick("attention", _rows(
+        "attention", "fused", [0.0], ok, "ring", [100.0]), incumbent="ring")
+    assert zero.choice == "ring"
+    # no incumbent evidence at all -> never adopt by void
+    void = registry.autopick("attention", _rows(
+        "attention", "fused", [103.0], ok), incumbent="ring")
+    assert void.choice == "ring"
+    assert any("void" in d["reason"] for d in void.dropped)
+
+
+def test_autopick_every_loser_lands_in_dropped():
+    pick = registry.autopick("attention", [], incumbent="ring")
+    assert pick.choice == "ring"
+    assert {d["candidate"] for d in pick.dropped} == {"flash", "fused"}
+    assert pick.as_dict()["rows_considered"] == 0
+
+
+def test_autopick_int8_min_gate():
+    # int8 adoption needs top-1 agreement ABOVE the floor, not just a
+    # small max_err — the "min" tolerance direction
+    rows = _rows("int8_matmul", "pallas_int8", [200.0],
+                 {"max_err": 1e-4, "top1_agree": 0.9},   # disagreement!
+                 "f32", [100.0])
+    pick = registry.autopick("int8_matmul", rows, incumbent="f32")
+    assert pick.choice == "f32"
+    rows = _rows("int8_matmul", "pallas_int8", [200.0],
+                 {"max_err": 1e-4, "top1_agree": 1.0}, "f32", [100.0])
+    assert registry.autopick("int8_matmul", rows,
+                             incumbent="f32").choice == "pallas_int8"
+
+
+# ---------------------------------------------------------- fused attention
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_attention_forward_parity(causal, dtype):
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 256, 3, 16), dtype) for kk in ks)
+    got = fused_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    want = reference_attention(q, k, v, causal=causal)
+    _close(got, want, dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_attention_gradient_parity(causal):
+    ks = jax.random.split(jax.random.key(1), 3)
+    q, k, v = (jax.random.normal(kk, (1, 128, 2, 8), jnp.float32)
+               for kk in ks)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v, causal=causal)))
+
+    g1 = jax.grad(loss(fused_attention), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(reference_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        _close(a, b, jnp.float32)
+
+
+def test_fused_attention_block_sweep_and_frontier():
+    """Asymmetric block configs exercise the traced frontier bound: the
+    kernel must stay exact when block_q != block_k."""
+    ks = jax.random.split(jax.random.key(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, 512, 1, 8), jnp.float32)
+               for kk in ks)
+    want = reference_attention(q, k, v, causal=True)
+    for bq, bk in ((128, 128), (256, 128), (128, 256), (512, 512)):
+        got = fused_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        _close(got, want, jnp.float32)
+
+
+# ------------------------------------------------------- fused ln + residual
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_layernorm_forward_parity_odd_rows(dtype):
+    # 101 rows: prime, forces the internal pad-and-slice path
+    ks = jax.random.split(jax.random.key(3), 4)
+    x = jax.random.normal(ks[0], (101, 48), dtype)
+    r = jax.random.normal(ks[1], (101, 48), dtype)
+    scale = jax.random.normal(ks[2], (48,)) + 1.0
+    bias = jax.random.normal(ks[3], (48,))
+    y1, h1 = fused_residual_layernorm(x, r, scale, bias, block_rows=32)
+    y2, h2 = reference_residual_layernorm(x, r, scale, bias)
+    _close(y1, y2, dtype)
+    _close(h1, h2, dtype)
+
+
+def test_fused_layernorm_gradient_parity_with_mask():
+    ks = jax.random.split(jax.random.key(4), 5)
+    x = jax.random.normal(ks[0], (67, 32), jnp.float32)
+    r = jax.random.normal(ks[1], (67, 32), jnp.float32)
+    scale = jax.random.normal(ks[2], (32,)) + 1.0
+    bias = jax.random.normal(ks[3], (32,))
+    mask = (jax.random.uniform(ks[4], (67, 1)) > 0.3).astype(jnp.float32)
+
+    def loss(fn):
+        def l(x, r, scale, bias):
+            y, h = fn(x, r, scale, bias, mask=mask)
+            return jnp.sum(jnp.sin(h)) + 0.1 * jnp.sum(y)
+        return l
+
+    g1 = jax.grad(loss(fused_residual_layernorm), argnums=(0, 1, 2, 3))(
+        x, r, scale, bias)
+    g2 = jax.grad(loss(reference_residual_layernorm), argnums=(0, 1, 2, 3))(
+        x, r, scale, bias)
+    for a, b in zip(g1, g2):
+        _close(a, b, jnp.float32)
+
+
+def test_fused_layernorm_batched_shape_roundtrip():
+    x = jax.random.normal(jax.random.key(5), (2, 37, 16), jnp.float32)
+    r = jnp.zeros_like(x)
+    y, h = fused_residual_layernorm(x, r, jnp.ones((16,)), jnp.zeros((16,)))
+    assert y.shape == h.shape == x.shape
+    _close(y, x, jnp.float32)
+
+
+# ------------------------------------------------------------- blocked xent
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_blocked_xent_forward_parity_near_prime(dtype):
+    # N=101 (prime) tokens, V=77 (odd, not a multiple of any block):
+    # both pad/mask paths fire
+    ks = jax.random.split(jax.random.key(6), 4)
+    h = jax.random.normal(ks[0], (101, 24), dtype)
+    head = (jax.random.normal(ks[1], (24, 77)) * 0.2).astype(dtype)
+    t = jax.random.randint(ks[2], (101,), 0, 77)
+    w = jax.random.uniform(ks[3], (101,))
+    got = blocked_cross_entropy(h, head, t, w, block_t=32, block_v=16)
+    want = reference_xent_sum(h, head, t, w)
+    np.testing.assert_allclose(float(got), float(want),
+                               rtol=1e-2 if dtype == jnp.bfloat16 else 1e-5)
+
+
+def test_blocked_xent_gradient_parity():
+    ks = jax.random.split(jax.random.key(7), 4)
+    h = jax.random.normal(ks[0], (101, 16), jnp.float32)
+    head = jax.random.normal(ks[1], (16, 53)) * 0.3
+    t = jax.random.randint(ks[2], (101,), 0, 53)
+    w = jax.random.uniform(ks[3], (101,))
+    g1 = jax.grad(lambda h, hd, w: blocked_cross_entropy(
+        h, hd, t, w, block_t=32, block_v=16), argnums=(0, 1, 2))(h, head, w)
+    g2 = jax.grad(lambda h, hd, w: reference_xent_sum(h, hd, t, w),
+                  argnums=(0, 1, 2))(h, head, w)
+    for a, b in zip(g1, g2):
+        _close(a, b, jnp.float32)
+
+
+def test_blocked_xent_under_jit_and_weightless():
+    h = jax.random.normal(jax.random.key(8), (64, 16), jnp.float32)
+    head = jax.random.normal(jax.random.key(9), (16, 32)) * 0.3
+    t = jax.random.randint(jax.random.key(10), (64,), 0, 32)
+    got = jax.jit(lambda h: blocked_cross_entropy(h, head, t))(h)
+    want = reference_xent_sum(h, head, t)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+
+def test_losses_dispatch_table_has_blocked_entry():
+    from deeplearning4j_tpu.ops import losses
+    assert losses.BLOCKED_XENT_BACKEND == "pallas"
+    fn = losses.get("blocked_mcxent")
+    labels = jnp.eye(8)[jnp.arange(8) % 8]
+    h = jax.random.normal(jax.random.key(11), (8, 16))
+    head = jax.random.normal(jax.random.key(12), (16, 8)) * 0.3
+    via_pair = fn(labels, (h, head))
+    logits = (h @ head).astype(jnp.float32)
+    via_logits = fn(labels, logits)
+    np.testing.assert_allclose(float(via_pair), float(via_logits), rtol=1e-5)
+
+
+def test_losses_fallback_matches_pallas_backend():
+    from deeplearning4j_tpu.ops import losses
+    h = jax.random.normal(jax.random.key(13), (45, 16), jnp.float32)
+    head = jax.random.normal(jax.random.key(14), (16, 19)) * 0.3
+    t = jax.random.randint(jax.random.key(15), (45,), 0, 19)
+    a = losses.blocked_token_xent(h, head, t)
+    b = losses._blocked_xent_fallback(h, head, t)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+
+# ------------------------------------------------------------- int8 matmul
+
+def test_quantize_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.key(16), (32, 24)) * 0.1
+    qw = quantize(w)
+    assert qw.q.dtype == jnp.int8 and qw.scale.shape == (24,)
+    # symmetric absmax: per-channel error <= scale/2 (half a quant step)
+    err = jnp.abs(dequantize(qw) - w)
+    assert bool(jnp.all(err <= qw.scale[None, :] * 0.5 + 1e-7))
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_int8_matmul_forward_parity(dtype):
+    w = jax.random.normal(jax.random.key(17), (32, 100)) * 0.05
+    qw = quantize(w)
+    x = jax.random.normal(jax.random.key(18), (3, 5, 32), dtype)
+    got = int8_matmul(x, qw, block_n=64)
+    want = reference_int8_matmul(x, qw)
+    assert got.dtype == jnp.float32
+    _close(got, want, dtype)
+
+
+def test_int8_matmul_gradient_flows_to_activations_only():
+    w = jax.random.normal(jax.random.key(19), (16, 24)) * 0.05
+    qw = quantize(w)
+    x = jax.random.normal(jax.random.key(20), (7, 16), jnp.float32)
+    g1 = jax.grad(lambda x: jnp.sum(jnp.sin(int8_matmul(x, qw))))(x)
+    g2 = jax.grad(lambda x: jnp.sum(jnp.sin(reference_int8_matmul(x, qw))))(x)
+    _close(g1, g2, jnp.float32)
+
+
+def test_quantized_tree_drops_f32_ffn_and_decode_agrees():
+    from deeplearning4j_tpu.models import transformer as tf
+    cfg = tf.TransformerConfig(vocab_size=97, d_model=32, n_heads=2,
+                               n_layers=2, max_len=64, dtype=jnp.float32)
+    params = tf.init_params(jax.random.key(21), cfg)
+    qp = quantize_params_for_decode(params, cfg)
+    for lp in qp["layers"]:
+        assert "w1" not in lp and "w2" not in lp
+        assert lp["w1_q"].q.dtype == jnp.int8
+    assert "head_q" in qp
+    cache = tf.init_decode_cache(cfg, 2)
+    toks = jnp.array([3, 5], jnp.int32)
+    lg_f32, _ = tf.decode_step(params, cache, toks, 0, cfg)
+    lg_i8, _ = tf.decode_step(qp, cache, toks, 0, cfg)
+    assert float(top1_agreement(lg_f32, lg_i8)) == 1.0
+
+
+# ------------------------------------------------- transformer-level parity
+
+def _tiny_cfg(**kw):
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+    return TransformerConfig(vocab_size=101, d_model=32, n_heads=2,
+                             n_layers=2, d_ff=64, max_len=128, causal=True,
+                             dtype=jnp.float32, **kw)
+
+
+@pytest.mark.parametrize("variant", [
+    {"attention": "fused"},
+    {"fused_ln": True},
+    {"xent_impl": "blocked", "xent_chunk": 64},
+])
+def test_transformer_kernel_variants_match_default(variant):
+    """Each bench-gated kernel opt-in computes the same loss and gradients
+    as the default XLA path (vocab 101 is prime: the blocked variant runs
+    the shape-independent streaming schedule, not a lucky divisor)."""
+    from deeplearning4j_tpu.models.transformer import (init_params,
+                                                       lm_loss_local)
+    cfg = _tiny_cfg()
+    params = init_params(jax.random.key(22), cfg)
+    toks = jax.random.randint(jax.random.key(23), (2, 128), 0, 101)
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    def run(c):
+        return jax.value_and_grad(
+            lambda p: lm_loss_local(p, toks, tgts, c))(params)
+
+    l0, g0 = run(cfg)
+    l1, g1 = run(_tiny_cfg(**variant))
+    assert abs(float(l0) - float(l1)) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_near_prime_token_count_streams_through_blocked_xent():
+    """The PR-5 zero-weight-padding fallback is gone: a near-prime token
+    count now routes to the blocked kernel and still matches the
+    unchunked loss exactly."""
+    from deeplearning4j_tpu.models.transformer import (init_params,
+                                                       lm_head_loss)
+    cfg = _tiny_cfg(xent_chunk=64)
+    params = init_params(jax.random.key(24), cfg)
+    # B*T = 1*127 (prime): the divisor search collapses below chunk//4
+    h = jax.random.normal(jax.random.key(25), (1, 127, 32), jnp.float32)
+    tgts = jax.random.randint(jax.random.key(26), (1, 127), 0, 101)
+    chunked = lm_head_loss(params, h, tgts, cfg)
+    full = lm_head_loss(params, h, tgts, _tiny_cfg(xent_chunk=0))
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-5)

@@ -191,6 +191,47 @@ def test_eos_evicts_slot_and_reuses_it(cycle_lm):
     assert METRICS.snapshot()["counters"]["serving.completed"] == 4
 
 
+# --------------------------------------------------------- int8 decode opt-in
+def test_int8_decode_opt_in_matches_offline_quantized_sample(lm):
+    """``int8_decode=True`` quantizes the SERVING copy of the params and
+    must be token-identical to sampling offline with the same quantized
+    tree (decode_step picks the int8 path on key presence).  The reload
+    template stays float so checkpoint restore shapes are unchanged."""
+    from deeplearning4j_tpu.ops.pallas.matmul_int8 import (
+        quantize_params_for_decode)
+
+    model, params = lm
+    qp = quantize_params_for_decode(params, model.cfg)
+    plans = [([5, 1, 4], 6, 0.0, 0),
+             ([7], 5, 0.0, 3),
+             ([2, 8, 2, 8], 4, 0.8, 123)]
+    want = [_expected(model, qp, p, n, t, s) for p, n, t, s in plans]
+
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2,
+                                               int8_decode=True))
+    assert "head_q" in engine._params            # serving copy: quantized
+    assert "head_q" not in engine._raw_params    # reload template: float
+    handles = [engine.submit(p, n, temperature=t, seed=s)
+               for p, n, t, s in plans]
+    with engine:
+        outs = [h.result(60.0) for h in handles]
+    assert [o.tokens for o in outs] == want
+    assert "serving.quantize" in METRICS.snapshot()["timers"]
+
+
+def test_int8_decode_is_off_by_default(lm):
+    """The default-config engine must serve the float params untouched —
+    int8 is strictly opt-in (the acceptance contract's parity tests above
+    all run through this default path)."""
+    model, params = lm
+    engine = InferenceEngine(model, params=params, cfg=ServingConfig())
+    assert ServingConfig().int8_decode is False
+    assert engine._params is engine._raw_params
+    assert "head_q" not in engine._params
+    engine.stop()
+
+
 # -------------------------------------------------------------- hot reload
 def test_hot_reload_mid_traffic(cycle_lm, tmp_path):
     """Swap to a newer checkpoint WITHOUT draining: the in-flight request

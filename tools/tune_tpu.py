@@ -4,7 +4,7 @@ the benchmark contract — bench.py remains the single source of truth; this
 script only informs which knobs bench.py should default to.
 
 Usage: python tools/tune_tpu.py
-           post|ablate|resnet_ablate|resnet_trace|bert|resnet|flash
+           post|pallas|ablate|resnet_ablate|resnet_trace|bert|resnet|flash
 """
 import json
 import os
@@ -318,9 +318,122 @@ def flash_check():
     return res
 
 
+def _timed(fn, *args, iters=8):
+    import jax
+    jax.block_until_ready(fn(*args))            # compile outside the timing
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return _median(times)
+
+
+def pallas_battery(iters=8, shapes=None):
+    """Generic TUNE rows for the ops/pallas kernel tier, one row per
+    (kernel, candidate, block config) plus a correctness row per
+    candidate — the schema ``bench.py``'s registry auto-pick consumes
+    (``{"kernel", "candidate", "block", "tokens_per_sec"}`` /
+    ``{"kernel", "candidate", "check"}``).  Yields dicts; the caller
+    prints them as JSONL."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas import registry
+    from deeplearning4j_tpu.ops.pallas.matmul_int8 import (quantize,
+                                                           top1_agreement)
+
+    rng = np.random.default_rng(0)
+    # shapes override exists so a CPU smoke can exercise every code path
+    # at toy sizes; the on-chip battery always runs the real ones
+    B, T, H, D, N, K, V = shapes or (4, 512, 12, 64, 4096, 768, 32768)
+    qkv = tuple(jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.bfloat16)
+                for _ in range(3))
+    x = jnp.asarray(rng.standard_normal((N, K)), jnp.bfloat16)
+    r = jnp.asarray(rng.standard_normal((N, K)), jnp.bfloat16)
+    scale = jnp.ones((K,), jnp.float32)
+    bias = jnp.zeros((K,), jnp.float32)
+    head = jnp.asarray(rng.standard_normal((K, V)) * 0.05, jnp.bfloat16)
+    tgt = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+    qw = quantize(jnp.asarray(rng.standard_normal((K, V)) * 0.05))
+
+    def grad_err(fn, ref, *args):
+        def loss(f):
+            def l(*a):
+                out = f(*a)
+                if isinstance(out, tuple):
+                    out = out[1]
+                return (out.astype(jnp.float32) ** 2).mean()
+            return l
+        ga = jax.jit(jax.grad(loss(fn)))(*args)
+        gb = jax.jit(jax.grad(loss(ref)))(*args)
+        return float(np.max(np.abs(np.asarray(ga, np.float32)
+                                   - np.asarray(gb, np.float32))))
+
+    # (kind, tokens-per-call, call(fn, **block), check(cand))
+    def attention_check(cand):
+        o = cand.fn(*qkv)
+        ref = cand.reference(*qkv)
+        return {"max_err": float(np.max(np.abs(
+                    np.asarray(o, np.float32) - np.asarray(ref, np.float32)))),
+                "grad_err": grad_err(cand.fn, cand.reference, *qkv)}
+
+    def ln_check(cand):
+        _, h = cand.fn(x, r, scale, bias)
+        _, hr = cand.reference(x, r, scale, bias)
+        return {"max_err": float(np.max(np.abs(
+            np.asarray(h, np.float32) - np.asarray(hr, np.float32))))}
+
+    def xent_check(cand):
+        a = float(cand.fn(x, head, tgt))
+        b = float(cand.reference(x, head, tgt))
+        return {"max_err": abs(a - b) / max(abs(b), 1e-9)}
+
+    def int8_check(cand):
+        o = cand.fn(x, qw)
+        ref = cand.reference(x, qw)
+        return {"max_err": float(np.max(np.abs(
+                    np.asarray(o) - np.asarray(ref)))),
+                "top1_agree": float(top1_agreement(o, ref))}
+
+    suites = (
+        ("attention", B * T, lambda fn, **blk: fn(*qkv, **blk),
+         attention_check),
+        ("layernorm_residual", N, lambda fn, **blk: fn(x, r, scale, bias,
+                                                       **blk), ln_check),
+        ("xent", N, lambda fn, **blk: fn(x, head, tgt, **blk), xent_check),
+        ("int8_matmul", N, lambda fn, **blk: fn(x, qw, **blk), int8_check),
+    )
+    for kind, tokens, call, check in suites:
+        for cand in registry.candidates(kind):
+            try:
+                yield {"kernel": kind, "candidate": cand.name,
+                       "check": check(cand)}
+            except Exception as e:
+                yield {"kernel": kind, "candidate": cand.name,
+                       "check_error": repr(e)[:300]}
+            for blk in (cand.blocks or ({},)):
+                try:
+                    med = _timed(jax.jit(lambda *a, c=cand, b=dict(blk):
+                                         call(c.fn, **b)), iters=iters)
+                    yield {"kernel": kind, "candidate": cand.name,
+                           "block": dict(blk), "median_ms": round(med * 1e3, 3),
+                           "tokens_per_sec": round(tokens / med, 1)}
+                except Exception as e:
+                    yield {"kernel": kind, "candidate": cand.name,
+                           "block": dict(blk), "error": repr(e)[:300]}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     out = []
+    if which == "pallas":
+        # the kernel-tier battery alone: one generic row per (kernel,
+        # candidate, block) + a check row per candidate, straight into
+        # the registry auto-pick's schema
+        for row in pallas_battery():
+            print(json.dumps(row), flush=True)
+        return
     if which == "post":
         # post-change battery: chunked-xent BERT (ring + flash) and the
         # space-to-depth ResNet at growing batch
@@ -328,6 +441,12 @@ def main():
             print(json.dumps({"flash_check": flash_check()}), flush=True)
         except Exception as e:
             print(json.dumps({"flash_check_error": repr(e)[:300]}), flush=True)
+        try:
+            for row in pallas_battery():
+                print(json.dumps(row), flush=True)
+        except Exception as e:
+            print(json.dumps({"pallas_battery_error": repr(e)[:300]}),
+                  flush=True)
         for fn, args, kw in ((bert_variant, (64, 512, "ring"), {}),
                              (bert_variant, (64, 512, "flash"), {}),
                              (bert_variant, (128, 512, "ring"), {}),
