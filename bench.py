@@ -773,6 +773,91 @@ def _scaling_leg(timeout_s: float = 420.0):
     }
 
 
+_ZERO_CHILD = r"""
+import json, time
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu import observability
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+observability.enable()
+D, BATCH, STEPS = 1024, 64, 12
+rng = np.random.default_rng(0)
+w_true = rng.normal(size=(D, 1))
+x = rng.normal(size=(BATCH, D)).astype(np.float32)
+y = (x @ w_true).astype(np.float32)
+
+def loss_fn(p, xb, yb, key=None):
+    return ((xb @ p["w"] - yb) ** 2).mean()
+
+out = {}
+for stage in (0, 1, 2):
+    METRICS.reset()
+    tr = DataParallelTrainer(loss_fn, T.adam(1e-3), zero_stage=stage)
+    state = tr.init_state({"w": np.zeros((D, 1), np.float32)})
+    state, lazy = tr.step(state, x, y)   # compile + settle placement
+    lazy.block(); tr._resolve_pending()
+    times, losses = [], []
+    for _ in range(STEPS):
+        t0 = time.perf_counter()
+        state, lazy = tr.step(state, x, y)
+        lazy.block()
+        times.append(time.perf_counter() - t0)
+        losses.append(float(lazy))
+    tr._resolve_pending()
+    g = METRICS.snapshot()["gauges"]
+    out[str(stage)] = {
+        "step_ms_median": round(sorted(times)[len(times) // 2] * 1e3, 3),
+        "opt_state_bytes_per_device": max(
+            v for k, v in g.items()
+            if k.startswith("train.opt_state_bytes.device.")),
+        "params_bytes_per_device": max(
+            v for k, v in g.items()
+            if k.startswith("train.params_bytes.device.")),
+        "losses": losses,
+    }
+print(json.dumps(out))
+"""
+
+
+def _zero_leg(timeout_s: float = 420.0):
+    """ZeRO stage comparison on the virtual 8-device CPU mesh (subprocess,
+    like ``_scaling_leg``): stage 0 vs 1 vs 2 step time plus the per-device
+    params/opt-state bytes the trainer gauges report.  Like the scaling
+    leg, virtual-mesh TIMING is host scheduling, not a chip claim — the
+    checkable facts are the 1/ndp opt-state shrink and loss parity across
+    stages; step times are published as a relative smell test only."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _ZERO_CHILD],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            raise RuntimeError(f"rc={proc.returncode}: {proc.stderr[-300:]}")
+        r = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:        # child died / bad stdout — never kill bench
+        return {"error": str(e)[:300]}
+    parity = all(r[s]["losses"] == r["0"]["losses"] for s in ("1", "2"))
+    shrink = (r["0"]["opt_state_bytes_per_device"]
+              / max(r["2"]["opt_state_bytes_per_device"], 1.0))
+    return {
+        "mode": "zero_stage_comparison_virtual_cpu_mesh",
+        "stages": {s: {k: v for k, v in r[s].items() if k != "losses"}
+                   for s in r},
+        "loss_parity_bitwise": parity,
+        "opt_state_shrink_x": round(shrink, 2),
+        "note": ("bytes/device + parity are the claims; virtual-mesh step "
+                 "times measure host scheduling, not chips"),
+    }
+
+
 _REAL_CONFIG_CHILD = r"""
 import json, sys
 import numpy as np
@@ -976,6 +1061,7 @@ def main():
         decode = {"error": repr(e)[:300]}
 
     scaling = _scaling_leg()
+    zero = _zero_leg()
     # when we could not reach the chip, at least prove the REAL configs
     # compile and record XLA's FLOPs for them (no timing claim)
     real_compile = None if on_tpu else _real_config_compile_check()
@@ -1041,6 +1127,7 @@ def main():
         "word2vec": w2v,
         "decode": decode,
         "dp_machinery_check": scaling,
+        "zero_sharding": zero,
         # which implementation each kernel kind would run in production
         # and why, with every dropped candidate's reason on record
         "kernel_picks": _kernel_picks(),

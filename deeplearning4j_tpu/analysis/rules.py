@@ -1,4 +1,4 @@
-"""The graftlint rule set — eight hazard classes from this repo's history.
+"""The graftlint rule set — nine hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -19,6 +19,9 @@
 |       | signal is swallowed untyped                                      |
 | PL01  | `pallas_call` without an `interpret=` keyword — the kernel body  |
 |       | can only execute on TPU, so CPU tier-1 tests never run it        |
+| ZR01  | replicated `device_put` of optimizer-state trees in ZeRO-aware   |
+|       | code with no `zero_stage` gate — silently re-replicates the      |
+|       | state ZeRO sharded, undoing the 1/ndp memory win                 |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -630,3 +633,143 @@ class PallasInterpretRule(Rule):
                 "only on TPU — CPU tier-1 tests can never execute the "
                 "kernel body; thread an interpret flag (auto-selected "
                 "off-TPU) through the wrapper")
+
+
+#: identifier fragments naming an optimizer-state tree
+_ZR_STATE_TOKENS = ("tstate", "opt_state")
+
+
+def _mentions_token(node: ast.AST, tokens) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and any(t in n.id.lower() for t in tokens):
+            return True
+        if isinstance(n, ast.Attribute) \
+                and any(t in n.attr.lower() for t in tokens):
+            return True
+    return False
+
+
+@register
+class ZeroReplicateRule(Rule):
+    """ZR01 — un-gated replicated placement of optimizer-state trees in
+    ZeRO-aware code.
+
+    Under ``zero_stage >= 2`` the optimizer state lives shard-local
+    (``NamedSharding(mesh, P('dp'))`` over the flattened layout, DESIGN.md
+    §15) — a ``jax.device_put`` of a tstate/opt_state tree with a
+    *replicated* sharding (``P()`` / ``NamedSharding(_, P())`` / a
+    ``*rep*``-named cached sharding) silently re-materializes the full
+    state on every chip, undoing the 1/ndp memory win without failing any
+    numerics test.  The rule scopes itself to functions that read
+    ``zero_stage`` (the code that KNOWS sharded state exists) and stays
+    quiet when the placement is gated by a ``zero_stage`` conditional:
+    inside any branch of an ``if``/``elif`` chain whose test mentions
+    ``zero_stage``, or after a ``zero_stage`` guard that early-returns.
+    Both the direct form and the ``tree_map(lambda ...: device_put(...),
+    tstate)`` form are caught.
+
+    Blind spots (documented, not accidental): placements routed through a
+    helper the AST can't see into, shardings aliased to names without a
+    ``rep`` fragment, and state trees not named ``*tstate*``/
+    ``*opt_state*`` — naming IS the contract in this tree.
+    """
+
+    id = "ZR01"
+    title = "replicated device_put of sharded optimizer state"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _mentions_token(node, ("zero_stage",)):
+                    yield from self._check_function(module, node)
+
+    # ------------------------------------------------------------- gating
+    def _gated_ids(self, fn: ast.AST) -> set[int]:
+        """ids of AST nodes covered by a ``zero_stage`` conditional: every
+        descendant of any branch of an If whose test reads zero_stage,
+        plus statements that only execute after such an If whose taken
+        branch leaves the block (early return/raise/continue/break)."""
+        gated: set[int] = set()
+
+        def mark(node: ast.AST):
+            for n in ast.walk(node):
+                gated.add(id(n))
+
+        # every statement list anywhere in the function is one block; a
+        # zero_stage If gates its own branches, and (when its taken branch
+        # leaves the block) everything after it in the same list
+        for n in ast.walk(fn):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(n, field, None)
+                if isinstance(stmts, list) and stmts \
+                        and all(isinstance(s, ast.stmt) for s in stmts):
+                    behind = False
+                    for s in stmts:
+                        if behind:
+                            mark(s)
+                            continue
+                        if isinstance(s, ast.If) and _mentions_token(
+                                s.test, ("zero_stage",)):
+                            for sub in s.body + s.orelse:
+                                mark(sub)
+                            if s.body and isinstance(
+                                    s.body[-1], (ast.Return, ast.Raise,
+                                                 ast.Continue, ast.Break)):
+                                behind = True
+        return gated
+
+    # ------------------------------------------------------------- shardings
+    def _is_replicated(self, module: ModuleInfo, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            canon = module.canonical(node.func) or dotted_name(node.func) or ""
+            seg = last_segment(canon) or canon
+            if seg in ("P", "PartitionSpec") \
+                    and not node.args and not node.keywords:
+                return True  # bare P(): fully replicated spec
+            if seg == "NamedSharding" and len(node.args) >= 2:
+                return self._is_replicated(module, node.args[1])
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "replicated":
+                return True
+            return False
+        name = dotted_name(node) or ""
+        seg = (last_segment(name) or name).lower()
+        return seg == "rep" or "rep_sh" in seg or "replicated" in seg
+
+    def _check_function(self, module: ModuleInfo,
+                        fn: ast.FunctionDef) -> Iterator[Finding]:
+        gated = self._gated_ids(fn)
+        for call in _calls_in(fn):
+            if id(call) in gated:
+                continue
+            canon = module.canonical(call.func) or dotted_name(call.func) or ""
+            seg = last_segment(canon) or canon
+            if seg == "device_put" and len(call.args) >= 2:
+                tree, sharding = call.args[0], call.args[1]
+                if _mentions_token(tree, _ZR_STATE_TOKENS) \
+                        and self._is_replicated(module, sharding):
+                    yield self._fire(module, call)
+            elif seg == "tree_map" and len(call.args) >= 2:
+                # tree_map(lambda x: device_put(x, rep), tstate): the
+                # device_put's first arg is the lambda var, so the state
+                # name lives on the mapped TREE argument instead
+                if not any(_mentions_token(a, _ZR_STATE_TOKENS)
+                           for a in call.args[1:]):
+                    continue
+                for inner in _calls_in(call.args[0]):
+                    iseg = last_segment(
+                        module.canonical(inner.func)
+                        or dotted_name(inner.func) or "") or ""
+                    if iseg == "device_put" and len(inner.args) >= 2 \
+                            and self._is_replicated(module, inner.args[1]):
+                        yield self._fire(module, inner)
+
+    def _fire(self, module: ModuleInfo, node: ast.AST) -> Finding:
+        return self.finding(
+            module, node,
+            "replicated `device_put` of an optimizer-state tree in "
+            "zero_stage-aware code with no `zero_stage` gate — under "
+            "zero_stage >= 2 this re-materializes the full state on every "
+            "chip, silently undoing the 1/ndp ZeRO memory win; branch on "
+            "`zero_stage` (replicate only when it is 0) or place with the "
+            "layout's dp shardings")

@@ -15,7 +15,7 @@ The production observability layer (grown from the seed
 
 from . import tracing as trace
 from .core import NOOP_SPAN, disable, enable, enabled
-from .device import sample_device_memory
+from .device import sample_device_memory, sample_state_bytes
 from .metrics import (
     DEFAULT_TIME_BUCKETS,
     METRICS,
@@ -30,5 +30,5 @@ __all__ = [
     "DEFAULT_TIME_BUCKETS", "Histogram", "METRICS", "MetricsRegistry",
     "NOOP_SPAN", "StatusServer", "StepTimer", "TRACER", "Tracer",
     "disable", "enable", "enabled", "profiler_trace",
-    "sample_device_memory", "span", "trace",
+    "sample_device_memory", "sample_state_bytes", "span", "trace",
 ]

@@ -4,9 +4,17 @@ Samples ``jax.local_devices()[*].memory_stats()`` into the metrics
 registry.  TPU/GPU backends report ``bytes_in_use`` / ``peak_bytes_in_use``
 / ``bytes_limit``; the CPU backend returns ``None`` — sampling is then a
 no-op, so instrumented paths can call this unconditionally.
+
+``sample_state_bytes`` sits next to the HBM gauges and makes the ZeRO
+memory win a scraped number instead of a claim: per-device bytes actually
+held by the param and optimizer-state trees, computed from shard METADATA
+(``addressable_shards`` shapes — no device sync, no transfer), published
+at trainer init and after every restore.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 from . import core
 from .metrics import METRICS, MetricsRegistry
@@ -37,3 +45,41 @@ def sample_device_memory(registry: MetricsRegistry = METRICS) -> int:
             if k in stats:
                 registry.gauge(prefix + k, float(stats[k]))
     return reported
+
+
+def _bytes_by_device(tree) -> dict[int, int]:
+    """Per-device bytes held by a pytree's placed arrays, from shard
+    metadata only.  Replicated leaves charge every device the full leaf;
+    dp-sharded leaves charge each device its chunk — exactly the
+    accounting that shows the 1/ndp ZeRO shrink."""
+    import jax
+
+    out: dict[int, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            shards = leaf.addressable_shards
+            itemsize = np.dtype(leaf.dtype).itemsize
+        except Exception:
+            continue
+        for sh in shards:
+            n = int(np.prod(sh.data.shape, dtype=np.int64)) \
+                if sh.data.shape else 1
+            out[sh.device.id] = out.get(sh.device.id, 0) + n * itemsize
+    return out
+
+
+def sample_state_bytes(params, tstate,
+                       registry: MetricsRegistry = METRICS) -> int:
+    """Gauge ``train.params_bytes.device.{id}`` and
+    ``train.opt_state_bytes.device.{id}``; returns devices reported."""
+    if not core.enabled():
+        return 0
+    seen: set[int] = set()
+    for name, tree in (("train.params_bytes", params),
+                       ("train.opt_state_bytes", tstate)):
+        for dev_id, nbytes in _bytes_by_device(tree).items():
+            registry.gauge(f"{name}.device.{dev_id}", float(nbytes))
+            seen.add(dev_id)
+    return len(seen)

@@ -86,6 +86,46 @@ def decay_leaf_mask(params):
     return tree_map(lambda w: jnp.ndim(w) >= 2, params)
 
 
+def state_shardings(transform: GradientTransform, params, leaf_spec, mesh):
+    """``NamedSharding`` tree for ``transform.init(params)``, derived from
+    ``state_spec`` with every param leaf placed as ``leaf_spec``.
+
+    This is the ZeRO plumbing: the trainer hands the FLATTENED param tree
+    (each leaf a padded 1-D chunk) with ``leaf_spec = P('dp')`` and jits
+    ``init`` with the returned tree as ``out_shardings`` — so optimizer
+    state is born shard-local (each chip materializes 1/ndp of every
+    leaf), never replicated-then-resharded.  ``state_spec`` callbacks keep
+    the structure contract ``init`` set (chain -> tuple of sub-states,
+    scale_by_adam -> (mu, nu), adagrad/momentum -> params-shaped), so the
+    spec tree and the state tree flatten to the same leaf sequence.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    param_specs = tree_map(lambda _: leaf_spec, params)
+    specs = ((transform.state_spec or _empty_spec)(param_specs))
+    state_shape = jax.eval_shape(transform.init, params)
+    treedef = jax.tree_util.tree_structure(state_shape)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    if len(spec_leaves) != treedef.num_leaves:
+        raise ValueError(
+            f"state_spec produced {len(spec_leaves)} leaf specs for a "
+            f"state with {treedef.num_leaves} leaves — a stateful "
+            "transform in the chain is missing (or mis-declaring) its "
+            "state_spec")
+    return jax.tree_util.tree_unflatten(
+        treedef, [NamedSharding(mesh, s) for s in spec_leaves])
+
+
+def init_sharded(transform: GradientTransform, params, leaf_spec, mesh):
+    """``transform.init(params)`` with every state leaf materialized
+    directly into the sharding ``state_shardings`` derives — the
+    shard-local construction path ZeRO trainers use (no full-size
+    intermediate on any single chip)."""
+    shardings = state_shardings(transform, params, leaf_spec, mesh)
+    return jax.jit(transform.init, out_shardings=shardings)(params)
+
+
 def chain(*transforms: GradientTransform) -> GradientTransform:
     def init(params):
         return tuple(t.init(params) for t in transforms)

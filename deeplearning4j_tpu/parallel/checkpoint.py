@@ -65,7 +65,11 @@ def _restore_like(template, arrays: dict[str, np.ndarray]):
             raise KeyError(f"checkpoint missing leaf {key}")
         used.add(key)
         arr = arrays[key]
-        if isinstance(leaf, (jnp.ndarray, np.ndarray)):
+        if isinstance(leaf, (jnp.ndarray, np.ndarray, jax.ShapeDtypeStruct)):
+            # abstract templates (ShapeDtypeStruct trees from eval_shape)
+            # are the ZeRO restore path: the caller re-flattens and
+            # re-shards the natural-layout arrays onto its CURRENT mesh,
+            # so no concrete template ever needs to materialize here
             leaves.append(jnp.asarray(arr))
         elif leaf is None:
             # a registered-leaf None (custom pytrees): NoneType() is not

@@ -37,6 +37,32 @@ def reduce_scatter(x, axis: str, *, scatter_dimension: int = 0):
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_dimension, tiled=True)
 
 
+# --------------------------------------------------------------- ZeRO helpers
+#
+# The sharded weight update (trainer zero_stage >= 1) communicates flattened
+# 1-D gradient/param chunks.  On a 1-member dp axis the tiled collectives
+# degenerate — the "scatter" of one tile is the whole array and the "gather"
+# of one shard is the input — so these wrappers take the axis size explicitly
+# and fall back to a plain psum / identity, keeping the dp=1 step the same
+# compiled program shape as the replicated path.
+
+def reduce_scatter_or_psum(x, axis: str, axis_size: int):
+    """Reduce-scatter ``x`` (1-D, length divisible by ``axis_size``) into
+    per-member contiguous tiles; psum fallback when the axis has one member
+    (sum of one shard = the shard, and the tile IS the array)."""
+    if axis_size == 1:
+        return lax.psum(x, axis)
+    return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+def all_gather_or_identity(x, axis: str, axis_size: int):
+    """Tiled all-gather of per-member chunks back to the full flattened
+    vector; identity when the axis has one member."""
+    if axis_size == 1:
+        return x
+    return lax.all_gather(x, axis, tiled=True)
+
+
 def ppermute(x, axis: str, perm):
     """Neighbor exchange — the ring primitive under ring attention /
     pipeline micro-batch handoff."""

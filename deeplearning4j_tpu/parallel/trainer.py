@@ -63,10 +63,12 @@ from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..datasets.dataset import DataSet
 from ..resilience.faults import FAULTS, DivergenceError
 from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
-from ..observability import sample_device_memory, trace
+from ..observability import sample_device_memory, sample_state_bytes, trace
 from ..optimize import transforms as tfm
+from . import collectives as clv
 from .compile_cache import setup_compile_cache
 from .mesh import DP, local_mesh
+from .zero import ZeroLayout
 
 LossFn = Callable[..., jnp.ndarray]  # (params, x, y, key) -> scalar
 
@@ -136,9 +138,17 @@ class DataParallelTrainer:
 
     def __init__(self, loss_fn: LossFn, transform: tfm.GradientTransform,
                  mesh: Mesh | None = None, router: str = "iterative_reduce",
-                 average_every: int = 8, max_pending: int = 64):
+                 average_every: int = 8, max_pending: int = 64,
+                 zero_stage: int = 0):
         if router not in ("iterative_reduce", "hogwild"):
             raise ValueError(f"unknown router {router!r}")
+        if zero_stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_stage must be 0..3, got {zero_stage!r}")
+        if zero_stage and router != "iterative_reduce":
+            raise ValueError(
+                "zero_stage requires the iterative_reduce router — hogwild "
+                "keeps independent per-replica optimizer state by design, "
+                "so there is no shared state to shard")
         self.loss_fn = loss_fn
         self.transform = transform
         self.mesh = mesh if mesh is not None else local_mesh()
@@ -146,6 +156,13 @@ class DataParallelTrainer:
         self.average_every = average_every
         self.max_pending = max(1, max_pending)
         self.n_dp = self.mesh.shape[DP]
+        # ZeRO stage (DESIGN.md §15): 0 replicates grads + optimizer state
+        # (the classic path); 1 shards optimizer state (all-reduce grads,
+        # update this chip's chunk, all-gather params); 2 reduce-scatters
+        # grads so full gradients never materialize; 3 additionally keeps
+        # PARAMS sharded between steps, gathering per microbatch.
+        self.zero_stage = int(zero_stage)
+        self._zero: ZeroLayout | None = None  # built at init_state
         # canonical placements for step arguments: batches split over dp,
         # scalars replicated.  Dispatch device_puts EVERY argument against
         # these (a no-op for already-placed arrays), so nothing reaches the
@@ -194,22 +211,43 @@ class DataParallelTrainer:
         # loop, and every leaf is explicitly re-placed below, so the
         # documented escape hatch applies here.
         with allow_transfers():
-            tstate = self.transform.init(
-                jax.tree_util.tree_map(lambda x: x[0], params)
-                if self.router == "hogwild" else params)
-        if self.router == "hogwild":
-            tstate = jax.tree_util.tree_map(
-                lambda x: (jnp.broadcast_to(x[None], (self.n_dp,) + x.shape)
-                           if isinstance(x, jnp.ndarray) else x), tstate)
-            tstate = jax.device_put(tstate, NamedSharding(self.mesh, P(DP)))
-        else:
-            # transform.init builds its buffers eagerly on one device;
-            # replicate them NOW so the first step's call needs no implicit
-            # reshard (the hot-loop transfer guard would reject it)
-            tstate = jax.tree_util.tree_map(
-                lambda x: (jax.device_put(x, self._rep_sh)
-                           if isinstance(x, jnp.ndarray) else x), tstate)
-        return TrainState(params=params, tstate=tstate, step=0, key=key)
+            if self.router == "hogwild":
+                tstate = self.transform.init(
+                    jax.tree_util.tree_map(lambda x: x[0], params))
+                tstate = jax.tree_util.tree_map(
+                    lambda x: (jnp.broadcast_to(x[None],
+                                                (self.n_dp,) + x.shape)
+                               if isinstance(x, jnp.ndarray) else x), tstate)
+                tstate = jax.device_put(tstate, NamedSharding(self.mesh, P(DP)))
+            elif self.zero_stage >= 1:
+                # ZeRO: optimizer state is born shard-local — init runs
+                # jitted over the flattened+padded param view with
+                # out_shardings from state_spec, so each chip materializes
+                # only its 1/ndp chunk of every state leaf
+                z = self._zero_layout(params)
+                flat_params = z.place_flat(params, z.flat_sharding)
+                tstate = tfm.init_sharded(self.transform, flat_params,
+                                          P(DP), self.mesh)
+                if self.zero_stage >= 3:
+                    params = flat_params  # params stay sharded between steps
+            else:
+                tstate = self.transform.init(params)
+                # transform.init builds its buffers eagerly on one device;
+                # replicate them NOW so the first step's call needs no
+                # implicit reshard (the hot-loop transfer guard rejects it)
+                tstate = jax.tree_util.tree_map(
+                    lambda x: (jax.device_put(x, self._rep_sh)
+                               if isinstance(x, jnp.ndarray) else x), tstate)
+        state = TrainState(params=params, tstate=tstate, step=0, key=key)
+        sample_state_bytes(state.params, state.tstate)  # ZeRO memory gauges
+        return state
+
+    def _zero_layout(self, params) -> ZeroLayout:
+        """Build (once) the flatten/pad/shard metadata for zero_stage >= 1.
+        Pure shape metadata — safe under a transfer guard."""
+        if self._zero is None:
+            self._zero = ZeroLayout(self.mesh, self.transform, params)
+        return self._zero
 
     # ------------------------------------------------------------------ buckets
     def _bucket_size(self, n: int) -> int:
@@ -291,6 +329,90 @@ class DataParallelTrainer:
             donate_argnums=(0, 1),
         )
 
+    def _build_zero_step(self):
+        """ZeRO sharded weight update (zero_stage >= 1), one shard_map'd
+        program per bucket:
+
+        local grads -> stage 1: all-reduce + slice this chip's chunk
+                       stage >= 2: reduce-scatter (full grads never land)
+        -> ``transform.update`` on this chip's flattened chunk only
+        -> stage <= 2: all-gather updated params, rebuild natural shapes
+           stage 3: params stay sharded; the NEXT step gathers them.
+
+        Numerics match the replicated step bitwise on the CPU mesh: the
+        per-row losses, the 1/n_valid cotangent, and the elementwise
+        transform are the same programs, and the cross-chip sum reduces
+        the same per-chip partials (psum / psum_scatter are the same
+        reduction, differently placed).  Norm-coupled transforms
+        (clip_unit_norm, clip_by_global_norm) would see shard-local norms
+        and are NOT exact under zero_stage >= 1 — documented in §15.
+        """
+        mesh, n_dp, stage = self.mesh, self.n_dp, self.zero_stage
+        z = self._zero
+        if z is None:
+            raise RuntimeError("zero step built before init_state — the "
+                               "layout comes from the param shapes")
+        loss_fn = self.loss_fn
+
+        def local(params, tstate, x, y, key, iteration, n_valid):
+            if stage >= 3:
+                flat_full = jax.tree_util.tree_map(
+                    lambda c: clv.all_gather_or_identity(c, DP, n_dp), params)
+                nat = z.unflatten_like(flat_full, z.natural_params)
+            else:
+                nat = params
+            idx = clv.axis_index(DP)
+            rows = idx * x.shape[0] + jnp.arange(x.shape[0])
+            mask = rows < n_valid
+
+            def local_sum(p):
+                per = jax.vmap(
+                    lambda xi, yi: loss_fn(p, xi[None], yi[None], key))(x, y)
+                per = per.reshape((x.shape[0],))
+                return jnp.sum(per * mask.astype(per.dtype))
+
+            # vjp with a 1/n_valid cotangent == grad of the GLOBAL masked
+            # mean: the division folds into the backward seed exactly where
+            # pjit's autodiff puts it, so per-chip partial grads are the
+            # same floats as the replicated step's pre-psum partials
+            lsum, vjp_fn = jax.vjp(local_sum, nat)
+            denom = n_valid.astype(lsum.dtype)
+            (grads,) = vjp_fn(jnp.ones((), lsum.dtype) / denom)
+            loss = clv.psum(lsum, DP) / denom
+            gflat = z.flatten_tree(grads)
+            if stage == 1:
+                gfull = jax.tree_util.tree_map(
+                    lambda g: clv.psum(g, DP), gflat)
+                gchunk = z.chunk_tree(gfull, idx, z.natural_params)
+            else:
+                gchunk = jax.tree_util.tree_map(
+                    lambda g: clv.reduce_scatter_or_psum(g, DP, n_dp), gflat)
+            if stage >= 3:
+                pchunk = params  # already this chip's chunks
+            else:
+                pchunk = z.chunk_tree(z.flatten_tree(nat), idx,
+                                      z.natural_params)
+            # decay classification must come from the NATURAL shapes — on
+            # 1-D chunks the ndim >= 2 heuristic would decay nothing
+            with tfm.decay_mask_override(z.decay_mask):
+                updates, tstate = self.transform.update(
+                    gchunk, tstate, pchunk, iteration)
+            pchunk = tfm.apply_updates(pchunk, updates)
+            if stage >= 3:
+                return pchunk, tstate, loss
+            pfull = jax.tree_util.tree_map(
+                lambda c: clv.all_gather_or_identity(c, DP, n_dp), pchunk)
+            return z.unflatten_like(pfull, z.natural_params), tstate, loss
+
+        param_spec = P(DP) if stage >= 3 else P()
+        smapped = shard_map(
+            local, mesh=mesh,
+            in_specs=(param_spec, P(DP), P(DP), P(DP), P(), P(), P()),
+            out_specs=(param_spec, P(DP), P()),
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=(0, 1))
+
     def _build_local_step(self):
         """HogWild-approx local step: runs independently per dp shard."""
         mesh = self.mesh
@@ -342,7 +464,8 @@ class DataParallelTrainer:
             # asserts on: steady-state recompiles == buckets used
             METRICS.increment("train_step.recompile")
             if self.router == "iterative_reduce":
-                fn = self._build_sync_step()
+                fn = (self._build_zero_step() if self.zero_stage
+                      else self._build_sync_step())
             else:
                 fn = self._build_local_step()
                 if self._avg_fn is None:
@@ -583,27 +706,92 @@ class DataParallelTrainer:
         # the save pulls every leaf to host: a sanctioned sync point, so it
         # re-allows transfers even when called inside the guarded fit loop
         with allow_transfers():
-            manager.save(state.step, state.params, tstate=state.tstate,
-                         key=state.key, data_cursor=state.step)
+            params, tstate, extra = state.params, state.tstate, None
+            if self.zero_stage >= 1:
+                # gather shard-local leaves and write the NATURAL layout:
+                # the on-disk format is identical across stages and dp
+                # widths, so restore can reshard onto any current mesh
+                # (np.asarray on a dp-sharded leaf assembles the full
+                # array from its chunks — single-host gather)
+                z = self._zero
+                tstate = z.to_natural_host(tstate, z.natural_tstate)
+                if self.zero_stage >= 3:
+                    params = z.to_natural_host(params, z.natural_params)
+                extra = {"zero_stage": self.zero_stage,
+                         "saved_dp": int(self.n_dp)}
+            manager.save(state.step, params, tstate=tstate,
+                         key=state.key, data_cursor=state.step, extra=extra)
 
     def restore(self, template: TrainState, manager) -> TrainState:
         """Restore the latest checkpoint into a state shaped like
-        ``template`` (fresh ``init_state`` output), re-placed on the mesh."""
-        r = manager.restore(template.params, tstate_template=template.tstate)
-        params = jax.tree_util.tree_map(
-            lambda t, a: jax.device_put(jnp.asarray(a), t.sharding),
-            template.params, r["params"])
-        tstate = template.tstate
-        if r["tstate"] is not None:
-            tstate = jax.tree_util.tree_map(
-                lambda t, a: (jax.device_put(jnp.asarray(a), t.sharding)
-                              if isinstance(t, jnp.ndarray) else a),
-                template.tstate, r["tstate"])
+        ``template`` (fresh ``init_state`` output), re-placed on the mesh.
+
+        Under zero_stage >= 1 the checkpoint holds the NATURAL layout
+        (see :meth:`checkpoint`), so restoring re-flattens and re-shards
+        onto THIS trainer's mesh — a checkpoint written at dp=2 restores
+        onto dp=1 (and vice versa) bit-for-bit."""
+        if self.zero_stage >= 1:
+            state = self._restore_zero(template, manager)
+        else:
+            r = manager.restore(template.params,
+                                tstate_template=template.tstate)
+            params = jax.tree_util.tree_map(
+                lambda t, a: jax.device_put(jnp.asarray(a), t.sharding),
+                template.params, r["params"])
+            tstate = template.tstate
+            if r["tstate"] is not None:
+                tstate = jax.tree_util.tree_map(
+                    lambda t, a: (jax.device_put(jnp.asarray(a), t.sharding)
+                                  if isinstance(t, jnp.ndarray) else a),
+                    template.tstate, r["tstate"])
+            key = r["key"] if r["key"] is not None else template.key
+            state = TrainState(params=params, tstate=tstate,
+                               step=r["step"], key=key)
+        sample_state_bytes(state.params, state.tstate)  # ZeRO memory gauges
+        return state
+
+    def _restore_zero(self, template: TrainState, manager) -> TrainState:
+        """Reshard a natural-layout checkpoint onto the current mesh: load
+        against abstract natural templates, then jit-flatten each tree
+        straight into its cached dp sharding (no replicated intermediate)."""
+        z = self._zero
+        if z is None:
+            # templates normally come from init_state (which builds the
+            # layout); under stage 3 they MUST — template params are
+            # already flat there, so natural shapes are unrecoverable
+            if self.zero_stage >= 3:
+                raise RuntimeError(
+                    "zero_stage=3 restore needs a template from init_state")
+            z = self._zero_layout(template.params)
+        # restore is a sanctioned sync point like save: loading npz leaves
+        # and re-placing them is setup, not the hot loop
+        with allow_transfers():
+            r = manager.restore(z.natural_params,
+                                tstate_template=z.natural_tstate)
+            nat_params = jax.tree_util.tree_map(jnp.asarray, r["params"])
+            if self.zero_stage >= 3:
+                params = z.place_flat(nat_params, z.flat_sharding)
+            else:
+                params = jax.device_put(nat_params, self._rep_sh)
+            tstate = template.tstate
+            if r["tstate"] is not None:
+                nat_t = jax.tree_util.tree_map(
+                    lambda a: (jnp.asarray(a)
+                               if isinstance(a, (jnp.ndarray, np.ndarray))
+                               else a), r["tstate"])
+                tstate = z.place_flat(nat_t, z.state_shardings)
         key = r["key"] if r["key"] is not None else template.key
-        return TrainState(params=params, tstate=tstate, step=r["step"], key=key)
+        return TrainState(params=params, tstate=tstate,
+                          step=r["step"], key=key)
 
     def final_params(self, state: TrainState):
-        """Collapse to a single param set (average replicas for hogwild)."""
+        """Collapse to a single param set (average replicas for hogwild;
+        gather + unflatten the sharded chunks for zero_stage 3)."""
+        if self.zero_stage >= 3:
+            z = self._zero
+            return jax.jit(
+                lambda t: z.unflatten_like(t, z.natural_params),
+                out_shardings=self._rep_sh)(state.params)
         if self.router == "hogwild":
             # one-shot post-fit collapse; the x[0] gather index is a
             # setup-style constant a surrounding guard would reject

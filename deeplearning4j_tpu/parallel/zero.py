@@ -1,0 +1,121 @@
+"""ZeRO layout: the flattened+padded view of a param tree that the sharded
+weight update (DESIGN.md §15, arXiv:2004.13336) trains in.
+
+Every leaf of the natural param tree maps to a 1-D vector zero-padded to a
+multiple of the dp width, so a ``NamedSharding(mesh, P('dp'))`` over the
+(only) axis gives each chip one contiguous, equal-size chunk per leaf.
+Optimizer-state leaves mirror the flat tree (the ``state_spec`` contract in
+``optimize/transforms``), which is what makes the shard-local
+``transform.update`` exact: every transform in this repo is elementwise
+over its leaves, so updating 1/ndp of the elements on each chip computes
+the same numbers the replicated update would — padding rows carry zero
+gradients and are sliced off before the natural view is rebuilt.
+
+The layout is pure metadata (``ShapeDtypeStruct`` trees + cached
+shardings): flatten/unflatten are trace-safe and appear both inside the
+jitted step (grads, param chunks) and on the host checkpoint path
+(``to_natural_host`` gathers shard-local leaves and restores natural
+shapes, so the on-disk format is identical across stages and dp widths —
+the portable-restore requirement of arXiv:2112.01075).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..optimize import transforms as tfm
+from .mesh import DP
+
+tree_map = jax.tree_util.tree_map
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _size(shape) -> int:
+    return int(np.prod(shape, dtype=np.int64)) if shape else 1
+
+
+class ZeroLayout:
+    """Static flatten/pad/shard metadata for one (mesh, transform, params).
+
+    Built once at ``init_state`` from abstract shapes only — nothing here
+    touches device memory, so constructing a layout is transfer-guard safe.
+    """
+
+    def __init__(self, mesh, transform: tfm.GradientTransform, params):
+        self.mesh = mesh
+        self.n_dp = int(mesh.shape[DP])
+        self.transform = transform
+        self.natural_params = jax.eval_shape(lambda t: t, params)
+        self.natural_tstate = jax.eval_shape(transform.init,
+                                             self.natural_params)
+        self.flat_sharding = NamedSharding(mesh, P(DP))
+        flat_params = jax.eval_shape(self.flatten_tree, self.natural_params)
+        self.state_shardings = tfm.state_shardings(
+            transform, flat_params, P(DP), mesh)
+        # weight-decay classification comes from the NATURAL layout: the
+        # ndim >= 2 heuristic is meaningless on 1-D chunks, so the sharded
+        # step pushes this mask through decay_mask_override
+        self.decay_mask = tree_map(lambda a: a.ndim >= 2, self.natural_params)
+
+    # ---------------------------------------------------------- per-leaf ops
+    def padded_size(self, size: int) -> int:
+        """Leaf length after zero-padding: dp-divisible, never empty."""
+        return max(_round_up(size, self.n_dp), self.n_dp)
+
+    def chunk_size(self, size: int) -> int:
+        return self.padded_size(size) // self.n_dp
+
+    def _flatten_leaf(self, x):
+        flat = jnp.reshape(x, (-1,))
+        pad = self.padded_size(flat.shape[0]) - flat.shape[0]
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        return flat
+
+    # ---------------------------------------------------------- tree ops
+    def flatten_tree(self, tree):
+        """Natural -> flat padded, leaf by leaf (trace-safe).  Works on any
+        tree whose array leaves carry natural shapes — params and the
+        optimizer state both, since state leaves mirror param shapes."""
+        return tree_map(self._flatten_leaf, tree)
+
+    def unflatten_like(self, flat_tree, natural_template):
+        """Flat padded -> natural shapes (trace-safe): slice the pad off,
+        reshape to the template leaf's shape."""
+        return tree_map(
+            lambda v, t: jnp.reshape(v[:_size(t.shape)], t.shape),
+            flat_tree, natural_template)
+
+    def chunk_tree(self, flat_tree, idx, natural_template):
+        """This chip's contiguous chunk of every flat leaf (inside
+        shard_map: ``idx = lax.axis_index(dp)``)."""
+        return tree_map(
+            lambda v, t: lax.dynamic_slice(
+                v, (idx * self.chunk_size(_size(t.shape)),),
+                (self.chunk_size(_size(t.shape)),)),
+            flat_tree, natural_template)
+
+    # ---------------------------------------------------------- host ops
+    def to_natural_host(self, flat_tree, natural_template):
+        """Gather shard-local flat leaves to host numpy and rebuild the
+        natural layout — the mesh-agnostic checkpoint payload (a zero-N
+        checkpoint is byte-compatible with a replicated one, and restores
+        onto any dp width)."""
+        return tree_map(
+            lambda v, t: (np.asarray(v)[:_size(t.shape)].reshape(t.shape)
+                          if isinstance(v, (jnp.ndarray, np.ndarray)) else v),
+            flat_tree, natural_template)
+
+    def place_flat(self, natural_tree, out_shardings):
+        """Natural-layout host/device arrays -> flat padded leaves placed
+        per ``out_shardings`` (restore path: reshard onto the CURRENT
+        mesh, whatever dp width wrote the checkpoint)."""
+        return jax.jit(self.flatten_tree,
+                       out_shardings=out_shardings)(natural_tree)

@@ -446,6 +446,98 @@ def test_pl01_quiet_when_interpret_is_threaded():
     assert lint(src, only="PL01") == []
 
 
+# --------------------------------------------------------------------------- ZR01
+
+ZR01_BAD = """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def init_state(self, params):
+        stage = self.zero_stage
+        tstate = self.transform.init(params)
+        tstate = jax.device_put(tstate, NamedSharding(self.mesh, P()))
+        return tstate
+"""
+
+ZR01_BAD_TREE_MAP = """
+    import jax
+
+    def restore(self, template):
+        stage = self.zero_stage
+        tstate = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._rep_sh), template.tstate)
+        return tstate
+"""
+
+ZR01_GOOD_GATED = """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def init_state(self, params):
+        if self.zero_stage >= 1:
+            tstate = self.init_sharded(params)
+        else:
+            tstate = jax.device_put(self.transform.init(params),
+                                    NamedSharding(self.mesh, P()))
+        return tstate
+"""
+
+ZR01_GOOD_EARLY_RETURN = """
+    import jax
+
+    def restore(self, template):
+        if self.zero_stage >= 1:
+            return self._restore_zero(template)
+        return jax.device_put(template.tstate, self._rep_sh)
+"""
+
+ZR01_GOOD_NOT_ZERO_AWARE = """
+    import jax
+
+    def init_state(self, params):
+        # stage-0-only trainer: replicating state is the correct layout
+        tstate = self.transform.init(params)
+        return jax.device_put(tstate, self._rep_sh)
+"""
+
+
+def test_zr01_fires_on_ungated_replicated_tstate_put():
+    findings = [f for f in lint(ZR01_BAD) if f.rule == "ZR01"]
+    assert len(findings) == 1
+    assert "zero_stage" in findings[0].message
+    assert "1/ndp" in findings[0].message
+
+
+def test_zr01_fires_on_tree_map_device_put_form():
+    findings = [f for f in lint(ZR01_BAD_TREE_MAP) if f.rule == "ZR01"]
+    assert len(findings) == 1
+
+
+def test_zr01_quiet_when_gated_by_zero_stage_branch():
+    assert lint(ZR01_GOOD_GATED, only="ZR01") == []
+
+
+def test_zr01_quiet_after_early_returning_zero_stage_guard():
+    assert lint(ZR01_GOOD_EARLY_RETURN, only="ZR01") == []
+
+
+def test_zr01_quiet_in_functions_that_never_read_zero_stage():
+    assert lint(ZR01_GOOD_NOT_ZERO_AWARE, only="ZR01") == []
+
+
+def test_zr01_quiet_on_dp_sharded_placement():
+    src = """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def init_state(self, params):
+            stage = self.zero_stage
+            tstate = self.transform.init(params)
+            return jax.device_put(tstate, NamedSharding(self.mesh, P("dp")))
+    """
+    assert lint(src, only="ZR01") == []
+
+
 # --------------------------------------------------------------------------- suppressions
 
 def test_same_line_pragma_suppresses_one_rule():

@@ -464,6 +464,37 @@ def test_metrics_dump_rendering():
         srv.stop()
     out = md.render_metrics(reg.snapshot())
     assert "steps" in out and "p95" in out
+    # no state gauges published -> no state-memory section
+    assert md.render_state_memory(reg.snapshot()) is None
+    reg.gauge("train.params_bytes.device.0", 2048.0)
+    reg.gauge("train.opt_state_bytes.device.0", 256.0)
+    section = md.render_state_memory(reg.snapshot())
+    assert "state memory" in section and "2.00KiB" in section \
+        and "256B" in section
+    assert "state memory" in md.render_metrics(reg.snapshot())
+
+
+def test_sample_state_bytes_gauges_sharded_trees():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.observability import sample_state_bytes
+    from deeplearning4j_tpu.parallel.mesh import DP, local_mesh
+
+    mesh = local_mesh()
+    n_dp = mesh.shape[DP]
+    rep = jax.device_put(jnp.zeros((n_dp * 4,), jnp.float32),
+                         NamedSharding(mesh, P()))
+    shd = jax.device_put(jnp.zeros((n_dp * 4,), jnp.float32),
+                         NamedSharding(mesh, P(DP)))
+    assert sample_state_bytes({"w": rep}, {"m": shd}, METRICS) == n_dp
+    g = METRICS.snapshot()["gauges"]
+    # replicated: every device holds the whole leaf; sharded: 1/ndp each
+    assert g["train.params_bytes.device.0"] == n_dp * 4 * 4
+    assert g["train.opt_state_bytes.device.0"] == 4 * 4
+    # non-array leaves pass through silently
+    assert sample_state_bytes({"k": 3}, (), METRICS) == 0
 
 
 def test_observe_shim_still_exports_legacy_names():

@@ -31,6 +31,18 @@ def test_perf_smoke_bounded_recompiles():
     assert result["losses_finite"]
 
 
+def test_perf_smoke_zero_leg():
+    """ZeRO leg: opt-state bytes/device ~ replicated/ndp AND the bucket
+    ladder survives the sharded step (recompiles == buckets)."""
+    ps = _load_perf_smoke()
+    result = ps.run_zero(steps=30)
+    assert result["recompiles"] == result["expected_buckets"]
+    rep, shard = result["opt_bytes_replicated"], result["opt_bytes_zero2"]
+    n_dp = result["n_dp"]
+    assert rep / n_dp <= shard <= rep / n_dp + result["pad_slack_bytes"]
+    assert result["losses_finite"]
+
+
 def test_expected_buckets_ladder():
     ps = _load_perf_smoke()
     # nominal 32 (first size), dp 8: pow2 ladder rounded to 8s, capped

@@ -4,9 +4,11 @@ Draws a random fault plan (transient step failure, corrupted checkpoint
 write, data-pipeline failure, simulated preemption) from a seed, runs a
 short supervised CPU fit under it, and asserts the run COMPLETES with
 parameters bitwise identical to a fault-free reference — the end-to-end
-recovery contract of DESIGN.md §12.  The seed is printed in the JSON
-result line, so any failing draw is replayable with
-``python tools/chaos_smoke.py --seed N``.
+recovery contract of DESIGN.md §12.  One plan runs per ZeRO stage
+(0/1/2/3, DESIGN.md §15), each compared against the replicated fault-free
+reference, so sharded-state checkpoints prove the same recovery contract.
+The seed is printed in the JSON result line, so any failing draw is
+replayable with ``python tools/chaos_smoke.py --seed N [--stage K]``.
 
 A second leg (``run_serving``) points the same dice at the serving
 subsystem: ``serving.request`` submission faults and ``serving.decode``
@@ -49,7 +51,7 @@ def _draw_plan(rng: random.Random):
     return specs
 
 
-def run(seed: int | None = None) -> dict:
+def run(seed: int | None = None, zero_stage: int = 0) -> dict:
     import jax
     import numpy as np
 
@@ -84,14 +86,17 @@ def run(seed: int | None = None) -> dict:
     def loss_fn(p, xb, yb, key=None):
         return jax.numpy.mean(((xb @ p["w"]) - yb) ** 2)
 
-    def new_trainer():
+    def new_trainer(stage=zero_stage):
         mesh = make_mesh(MeshSpec(dp=8), devices=jax.devices()[:8])
         return DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
                                                     T.sgd_lr(5e-2)),
-                                   mesh=mesh)
+                                   mesh=mesh, zero_stage=stage)
 
     params = {"w": np.zeros(3, np.float32)}
-    t_ref = new_trainer()
+    # the fault-free reference always runs REPLICATED (stage 0): the chaos
+    # claim under ZeRO is recovery parity against classic numerics, not
+    # just against another sharded run
+    t_ref = new_trainer(stage=0)
     s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1)
 
     plan = _draw_plan(rng)
@@ -105,10 +110,13 @@ def run(seed: int | None = None) -> dict:
             state, losses = sup.fit(trainer, params, data, epochs=1,
                                     checkpoint_every=2)
 
+    # compare NATURAL layouts: under zero_stage=3 state.params are the
+    # flat dp-sharded chunks, so collapse both sides via final_params
     params_equal = all(
         np.array_equal(np.asarray(a), np.asarray(b))
-        for a, b in zip(jax.tree_util.tree_leaves(s_ref.params),
-                        jax.tree_util.tree_leaves(state.params)))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(t_ref.final_params(s_ref)),
+            jax.tree_util.tree_leaves(trainer.final_params(state))))
     # losses from aborted attempts die with the pending ring, leaving
     # gaps, so align by STEP: every loss a successful attempt resolved
     # must match the reference loss at the same step exactly
@@ -117,6 +125,7 @@ def run(seed: int | None = None) -> dict:
     counters = METRICS.snapshot()["counters"]
     result = {
         "seed": seed,
+        "zero_stage": zero_stage,
         "plan": [f"{s.site}:at={s.at_step},kind={s.kind}" for s in plan],
         "final_step": int(state.step),
         "ref_step": int(s_ref.step),
@@ -217,8 +226,21 @@ def run_serving(seed: int) -> dict:
 
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
+    if "--stage" in argv:
+        # replay a single failing (seed, stage) draw
+        stage = int(argv[argv.index("--stage") + 1])
+        result = run(seed, zero_stage=stage)
+        print(json.dumps(result))
+        return 0
+    # one random plan per ZeRO stage: recovery must restore BITWISE params
+    # whether optimizer state (and, at stage 3, params) live sharded or
+    # replicated — a corrupted/per-shard-mismatched restore would show up
+    # as parity failure here
     result = run(seed)
-    result["serving"] = run_serving(result["seed"])
+    base = result["seed"]
+    result["zero_stages"] = {
+        stage: run(base + stage, zero_stage=stage) for stage in (1, 2, 3)}
+    result["serving"] = run_serving(base)
     print(json.dumps(result))
     return 0
 

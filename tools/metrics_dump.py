@@ -55,8 +55,41 @@ def _fmt_s(v: float) -> str:
     return f"{v * 1e3:.2f}ms"
 
 
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if v < 1024 or unit == "GiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.2f}{unit}"
+        v /= 1024
+    return f"{v:.2f}GiB"
+
+
+def render_state_memory(snap: dict) -> str | None:
+    """Per-device params / optimizer-state footprint table, from the
+    ``train.params_bytes`` / ``train.opt_state_bytes`` gauges the trainer
+    publishes at init and after restore.  Under ZeRO (zero_stage >= 1)
+    the opt-state column shows the ~1/ndp shrink directly.  Returns None
+    when the job published no state gauges (pre-ZeRO jobs)."""
+    gauges = snap.get("gauges", {})
+    devs: dict[str, dict[str, float]] = {}
+    for prefix, col in (("train.params_bytes.device.", "params"),
+                        ("train.opt_state_bytes.device.", "opt_state")):
+        for k, v in gauges.items():
+            if k.startswith(prefix):
+                devs.setdefault(k[len(prefix):], {})[col] = v
+    if not devs:
+        return None
+    rows = [(d, _fmt_bytes(c.get("params", 0.0)),
+             _fmt_bytes(c.get("opt_state", 0.0)))
+            for d, c in sorted(devs.items(), key=lambda kv: kv[0])]
+    return _rows("state memory (per device)", rows,
+                 ("device", "params", "opt_state"))
+
+
 def render_metrics(snap: dict) -> str:
     parts = []
+    state_mem = render_state_memory(snap)
+    if state_mem is not None:
+        parts.append(state_mem)
     parts.append(_rows(
         "counters", sorted(snap.get("counters", {}).items()),
         ("name", "value")))

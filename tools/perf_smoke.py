@@ -96,8 +96,94 @@ def run(steps: int = 30) -> dict:
     return result
 
 
+def run_zero(steps: int = 30) -> dict:
+    """ZeRO leg: a zero_stage=2 fit must keep the bounded-recompile
+    invariant AND actually shrink optimizer-state bytes/device to
+    ~replicated/ndp (within flatten-padding tolerance) — the memory win
+    is asserted from the ``train.opt_state_bytes`` gauges, not inferred."""
+    import numpy as np
+
+    import jax
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    observability.enable()
+
+    d = 64  # big enough that per-leaf pad rows are noise vs the total
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(d, 1))
+
+    def loss_fn(p, x, y, key=None):
+        return ((x @ p["w"] - y) ** 2).mean()
+
+    def batches():
+        for k in range(steps):
+            n = RAGGED_SIZES[k % len(RAGGED_SIZES)]
+            x = rng.normal(size=(n, d)).astype(np.float32)
+            yield DataSet(x, (x @ w_true).astype(np.float32))
+
+    def opt_bytes_per_device():
+        gauges = METRICS.snapshot()["gauges"]
+        vals = [v for k, v in gauges.items()
+                if k.startswith("train.opt_state_bytes.device.")]
+        assert vals, "train.opt_state_bytes gauges missing"
+        return max(vals)
+
+    def one(stage):
+        METRICS.reset()
+        # momentum: a stateful transform, so there are bytes to shard
+        tr = DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
+                                                  T.sgd_lr(0.05)),
+                                 zero_stage=stage)
+        params = {"w": np.zeros((d, 1), np.float32)}
+        state, losses = tr.fit(tr.init_state(params), batches())
+        snap = METRICS.snapshot()["counters"]
+        return tr, {
+            "recompiles": int(snap.get("train_step.recompile", 0)),
+            "opt_state_bytes_per_device": opt_bytes_per_device(),
+            "final_loss": losses[-1],
+            "losses_finite": all(math.isfinite(l) for l in losses),
+        }
+
+    tr0, r0 = one(0)
+    tr2, r2 = one(2)
+    n_dp = tr2.n_dp
+    n_buckets = len(expected_buckets(
+        [RAGGED_SIZES[k % len(RAGGED_SIZES)] for k in range(steps)], n_dp))
+    z = tr2._zero
+    # each leaf pads by < n_dp elements; the per-device share of all pad
+    # is at most one element per leaf (times itemsize)
+    pad_slack = sum(
+        np.dtype(l.dtype).itemsize
+        for l in jax.tree_util.tree_leaves(z.natural_tstate))
+    result = {
+        "n_dp": n_dp,
+        "zero_stage": 2,
+        "recompiles": r2["recompiles"],
+        "expected_buckets": n_buckets,
+        "opt_bytes_replicated": r0["opt_state_bytes_per_device"],
+        "opt_bytes_zero2": r2["opt_state_bytes_per_device"],
+        "pad_slack_bytes": pad_slack,
+        "losses_finite": r0["losses_finite"] and r2["losses_finite"],
+    }
+    assert result["losses_finite"], "non-finite loss in zero smoke run"
+    assert r2["recompiles"] == n_buckets, (
+        f"zero_stage=2: {r2['recompiles']} recompiles != {n_buckets} "
+        "buckets — the sharded step broke the bucket ladder")
+    rep, shard = result["opt_bytes_replicated"], result["opt_bytes_zero2"]
+    assert rep / n_dp <= shard <= rep / n_dp + pad_slack, (
+        f"opt-state bytes/device {shard} outside "
+        f"[{rep / n_dp}, {rep / n_dp + pad_slack}] — the 1/ndp ZeRO "
+        "shrink regressed")
+    return result
+
+
 def main() -> int:
     print(json.dumps(run()))
+    print(json.dumps(run_zero()))
     return 0
 
 

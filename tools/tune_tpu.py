@@ -424,9 +424,62 @@ def pallas_battery(iters=8, shapes=None):
                            "block": dict(blk), "error": repr(e)[:300]}
 
 
+def zero_battery(iters=12, d=4096, batch=64):
+    """ZeRO rows: one per stage — step time plus the per-device
+    params/opt-state bytes from the trainer's gauges.  On real chips this
+    is the stage-selection table DESIGN.md §15 owes its numbers to; on
+    CPU the byte columns are still exact (they come from shard metadata,
+    not timing).  Yields JSONL row dicts like ``pallas_battery``."""
+    import jax
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+
+    observability.enable()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, d)).astype(np.float32)
+    y = rng.normal(size=(batch, 1)).astype(np.float32)
+
+    def loss_fn(p, xb, yb, key=None):
+        return ((xb @ p["w"] - yb) ** 2).mean()
+
+    for stage in (0, 1, 2, 3):
+        METRICS.reset()
+        tr = DataParallelTrainer(loss_fn, T.adam(1e-3), zero_stage=stage)
+        state = tr.init_state({"w": np.zeros((d, 1), np.float32)})
+        state, lazy = tr.step(state, x, y)  # compile + settle placements
+        lazy.block()
+        tr._resolve_pending()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            state, lazy = tr.step(state, x, y)
+            lazy.block()
+            times.append(time.perf_counter() - t0)
+        tr._resolve_pending()
+        g = METRICS.snapshot()["gauges"]
+
+        def per_dev(prefix):
+            vals = [v for k, v in g.items() if k.startswith(prefix)]
+            return max(vals) if vals else None
+
+        yield {"battery": "zero", "zero_stage": stage, "n_dp": tr.n_dp,
+               "d": d, "batch": batch,
+               "median_ms": round(_median(times) * 1e3, 3),
+               "params_bytes_per_device": per_dev(
+                   "train.params_bytes.device."),
+               "opt_state_bytes_per_device": per_dev(
+                   "train.opt_state_bytes.device.")}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     out = []
+    if which == "zero":
+        for row in zero_battery():
+            print(json.dumps(row), flush=True)
+        return
     if which == "pallas":
         # the kernel-tier battery alone: one generic row per (kernel,
         # candidate, block) + a check row per candidate, straight into
