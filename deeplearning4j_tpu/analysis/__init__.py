@@ -11,7 +11,10 @@ Two halves of one contract (DESIGN.md §11):
   violations only.
 - **runtime**: ``runtime.hot_loop_guard()`` wraps the trainer/bench hot
   loops in ``jax.transfer_guard("disallow")`` so implicit transfers fail
-  loudly at the call site (opt out: ``DL4J_TPU_TRANSFER_GUARD=0``).
+  loudly at the call site (opt out: ``DL4J_TPU_TRANSFER_GUARD=0``), and
+  ``lockguard.LOCKGUARD`` instruments ``threading`` locks to detect
+  lock-order inversions and Eraser-style unguarded shared writes at
+  test time (``@pytest.mark.lockguard`` / ``DL4J_TPU_LOCKGUARD=1``).
 
 Results flow through the PR 1 observability layer as
 ``graftlint.violations.<RULE>`` gauges (``report.emit_metrics``).
@@ -21,12 +24,15 @@ from .baseline import Baseline
 from .core import ACTIVE, BASELINED, SUPPRESSED, Finding, Rule, all_rules
 from .engine import Analyzer, active
 from .jitinfo import JitInfo, ModuleInfo
+from .lockguard import (ENV_LOCKGUARD, LOCKGUARD, LockGuard, Violation,
+                        enabled_from_env, lockguard_active)
 from .report import emit_metrics, summarize, to_json, to_text
 from .runtime import ENV_FLAG, allow_transfers, guard_mode, hot_loop_guard
 
 __all__ = [
-    "ACTIVE", "Analyzer", "BASELINED", "Baseline", "ENV_FLAG", "Finding",
-    "JitInfo", "ModuleInfo", "Rule", "SUPPRESSED", "active", "all_rules",
-    "allow_transfers", "emit_metrics", "guard_mode", "hot_loop_guard",
-    "summarize", "to_json", "to_text",
+    "ACTIVE", "Analyzer", "BASELINED", "Baseline", "ENV_FLAG",
+    "ENV_LOCKGUARD", "Finding", "JitInfo", "LOCKGUARD", "LockGuard",
+    "ModuleInfo", "Rule", "SUPPRESSED", "Violation", "active", "all_rules",
+    "allow_transfers", "emit_metrics", "enabled_from_env", "guard_mode",
+    "hot_loop_guard", "lockguard_active", "summarize", "to_json", "to_text",
 ]

@@ -1,4 +1,4 @@
-"""The graftlint rule set — nine hazard classes from this repo's history.
+"""The graftlint rule set — thirteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -22,6 +22,18 @@
 | ZR01  | replicated `device_put` of optimizer-state trees in ZeRO-aware   |
 |       | code with no `zero_stage` gate — silently re-replicates the      |
 |       | state ZeRO sharded, undoing the 1/ndp memory win                 |
+| LK01  | unguarded write to a lock-guarded / thread-shared attribute      |
+|       | (explicit `# guarded-by:` contract, majority-guarded inference,  |
+|       | or written from two thread contexts with no lock ever held)      |
+| LK02  | inconsistent lock-acquisition order: the static lock-order       |
+|       | graph (nested `with` + helper-call propagation) has a cycle —    |
+|       | a deadlock schedule, incl. non-reentrant self-re-acquisition     |
+| LK03  | blocking call while holding a lock (`block_until_ready`,         |
+|       | untimed `.wait()`/`.join()`/`.get()`, socket/HTTP I/O,           |
+|       | `time.sleep`) — a convoy or deadlock under contention            |
+| TH01  | `threading.Thread` created with neither `daemon=True` nor a      |
+|       | visible `join()`/daemon-flag lifecycle — leaks a thread that     |
+|       | can hang interpreter shutdown                                    |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -31,8 +43,10 @@ the committed baseline with a justification.
 from __future__ import annotations
 
 import ast
+from collections import Counter
 from typing import Iterator
 
+from .concurrency import _INIT_METHODS, find_cycles, module_concurrency
 from .core import (
     Finding,
     Rule,
@@ -773,3 +787,263 @@ class ZeroReplicateRule(Rule):
             "chip, silently undoing the 1/ndp ZeRO memory win; branch on "
             "`zero_stage` (replicate only when it is 0) or place with the "
             "layout's dp shardings")
+
+
+# ------------------------------------------------------------------ LK01-TH01
+
+@register
+class UnguardedSharedWriteRule(Rule):
+    """LK01: an attribute the class treats as lock-guarded is written
+    without the lock — or is written from two thread contexts with no
+    lock at all.
+
+    Three triggers, in priority order:
+
+    1. **declared contract**: any assignment line carrying a
+       ``# guarded-by: self._lock`` comment makes every later write of
+       that attribute outside ``with self._lock:`` a finding;
+    2. **majority inference**: when at least half of an attribute's
+       non-``__init__`` writes hold some lock, the unlocked minority are
+       the bug (PR 1's StepTimer race was exactly this shape);
+    3. **shared-context inference**: in a class that spawns threads or
+       handles HTTP, an attribute written both from a thread-entry
+       context (``Thread(target=...)`` closure, ``do_GET``) and from
+       caller-facing methods, with no write ever locked, is a data race
+       waiting for load.  One finding per attribute, anchored at the
+       first unlocked write.
+
+    Blind spots: reads are not tracked; ``acquire()``/``release()``
+    pairs are invisible (use ``with``); aliasing (``s = self.slots``)
+    hides writes; happens-before edges that are real but invisible to
+    the AST (warmup-before-start) need an inline suppression with the
+    reason spelled out.
+    """
+
+    id = "LK01"
+    title = "unguarded write to lock-guarded/thread-shared attribute"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = module_concurrency(module)
+        for cls in model.classes:
+            yield from self._check_class(module, cls)
+
+    def _check_class(self, module: ModuleInfo,
+                     cls) -> Iterator[Finding]:
+        for attr in sorted(cls.writes):
+            body = [w for w in cls.writes[attr]
+                    if w.method not in _INIT_METHODS]
+            if not body:
+                continue
+            body.sort(key=lambda w: getattr(w.node, "lineno", 0))
+            lock = cls.guarded_by.get(attr)
+            if lock is not None:
+                for w in body:
+                    if lock not in w.held:
+                        yield self.finding(
+                            module, w.node,
+                            f"write to `self.{attr}` in `{cls.name}."
+                            f"{w.method}` without holding `self.{lock}` "
+                            f"(declared `# guarded-by: self.{lock}`)")
+                continue
+            unlocked = [w for w in body if not w.held]
+            locked = [w for w in body if w.held]
+            if not unlocked:
+                continue
+            if locked and len(locked) >= len(unlocked):
+                guard = Counter(
+                    l for w in locked for l in w.held).most_common(1)[0][0]
+                others = ", ".join(
+                    f"{w.method}:{getattr(w.node, 'lineno', '?')}"
+                    for w in unlocked[1:]) or "none"
+                yield self.finding(
+                    module, unlocked[0].node,
+                    f"`self.{attr}` is written under `self.{guard}` in "
+                    f"{len(locked)} of {len(body)} sites but not in "
+                    f"`{cls.name}.{unlocked[0].method}` (other unlocked "
+                    f"sites: {others}) — take the lock, or annotate the "
+                    f"deliberate exception with a reason")
+            elif cls.threaded:
+                ctxs = set()
+                for w in body:
+                    ctxs |= cls.contexts(w.method)
+                if len(ctxs) >= 2:
+                    roots = ", ".join(sorted(ctxs))
+                    sites = ", ".join(sorted(
+                        {f"{w.method}:{getattr(w.node, 'lineno', '?')}"
+                         for w in body}))
+                    yield self.finding(
+                        module, unlocked[0].node,
+                        f"`self.{attr}` is written from multiple thread "
+                        f"contexts ({roots}; sites {sites}) with no lock "
+                        f"ever held in `{cls.name}` — guard it (declare "
+                        f"`# guarded-by: self._lock` and wrap writes in "
+                        f"`with self._lock:`) or suppress with the "
+                        f"happens-before argument spelled out")
+
+
+@register
+class LockOrderRule(Rule):
+    """LK02: the module's static lock-order graph has a cycle.
+
+    Nested ``with`` acquisitions and one level of ``self.m()`` helper
+    propagation yield ``held -> acquired`` edges; any cycle is a
+    schedule where two threads deadlock (or, for a length-1 cycle on a
+    non-reentrant ``threading.Lock``, one thread deadlocks itself
+    through a helper that re-takes the lock it already holds).
+
+    Blind spots: cross-module cycles (lock identities are
+    ``Class.attr``-scoped per module), ``acquire()`` call pairs, and
+    locks passed as arguments.
+    """
+
+    id = "LK02"
+    title = "inconsistent lock-acquisition order (deadlock schedule)"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = module_concurrency(module)
+        for cyc in find_cycles(model.edges):
+            e = cyc[0]
+            if len(cyc) == 1 and e.held == e.acquired:
+                yield self.finding(
+                    module, e.node,
+                    f"`{e.acquired}` is a non-reentrant Lock already held "
+                    f"in `{e.func}` when it is re-acquired — guaranteed "
+                    f"self-deadlock; use RLock or hoist the helper's "
+                    f"locking to the caller")
+                continue
+            path = " -> ".join([c.held for c in cyc] + [cyc[0].held])
+            where = "; ".join(
+                f"{c.held}->{c.acquired} in {c.func}:"
+                f"{getattr(c.node, 'lineno', '?')}" for c in cyc)
+            yield self.finding(
+                module, e.node,
+                f"lock-order cycle {path} ({where}) — two threads taking "
+                f"these paths concurrently deadlock; pick one global "
+                f"order and re-nest the minority site")
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    """LK03: a call that can block indefinitely runs while a lock is
+    held — every other thread needing that lock convoys behind device
+    work, socket I/O, or an untimed wait (and if the blocked operation
+    itself needs the lock to make progress, it is a deadlock).
+
+    Condition-variable waits on the *same* lock being held are exempt
+    (``wait`` releases its own lock); timed waits/joins/gets are exempt
+    (bounded convoy).  Blind spots: blocking hidden behind helper
+    functions, and ``dict.get(key)``-vs-``queue.get()`` is told apart
+    only by argument count.
+    """
+
+    id = "LK03"
+    title = "blocking call while holding a lock"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        model = module_concurrency(module)
+        for node, why, func in model.blocking:
+            yield self.finding(
+                module, node,
+                f"{why} while holding a lock in `{func}` — threads "
+                f"contending for the lock convoy behind this call (a "
+                f"deadlock if the blocked work needs the same lock); "
+                f"move it outside the `with`, or bound it with a timeout")
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    """TH01: a ``threading.Thread`` is created with neither
+    ``daemon=True`` nor any visible join/daemon lifecycle.
+
+    A non-daemon thread with no ``join()`` keeps the interpreter alive
+    after ``main`` returns — test runs and CLI tools hang on exit, and
+    there is no orderly shutdown path.  Accepted lifecycles: a
+    ``daemon=True`` kwarg, a later ``<name>.daemon = True`` assignment
+    or ``setDaemon(True)`` call, or a ``.join(...)`` on the variable (or
+    attribute basename) the thread was assigned to — including threads
+    built in a comprehension bound to a container that is then joined
+    through a loop variable (``ts = [Thread(...) ...]`` /
+    ``for t in ts: t.join()``).
+
+    Blind spots: ``Thread`` subclasses instantiated by their own name,
+    and joins that live in another module.
+    """
+
+    id = "TH01"
+    title = "thread without daemon flag or join lifecycle"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        joined: set[str] = set()
+        daemonized: set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if recv and node.func.attr == "join":
+                    joined.add(last_segment(recv))
+                if recv and node.func.attr == "setDaemon":
+                    daemonized.add(last_segment(recv))
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    name = dotted_name(t)
+                    if name and last_segment(name) == "daemon":
+                        owner = name.rsplit(".", 2)
+                        if len(owner) >= 2:
+                            daemonized.add(owner[-2])
+        # a container joined through a loop variable counts: the loop var
+        # landed in `joined` above, so lift that onto the iterated name
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)) \
+                    and isinstance(node.target, ast.Name):
+                src = dotted_name(node.iter)
+                if src:
+                    if node.target.id in joined:
+                        joined.add(last_segment(src))
+                    if node.target.id in daemonized:
+                        daemonized.add(last_segment(src))
+        compound = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                    ast.For, ast.AsyncFor, ast.While, ast.If, ast.Try,
+                    ast.With, ast.AsyncWith)
+        for stmt in body_statements(module.tree.body, into_defs=True):
+            if isinstance(stmt, compound):
+                continue       # its simple statements are enumerated anyway
+            for call, bound in self._thread_calls(module, stmt):
+                kw = {k.arg: k.value for k in call.keywords}
+                d = kw.get("daemon")
+                if d is not None and not (
+                        isinstance(d, ast.Constant) and d.value is False):
+                    continue
+                base = last_segment(bound) if bound else None
+                if base and (base in joined or base in daemonized):
+                    continue
+                held = f"bound to `{bound}`" if bound else "never bound"
+                yield self.finding(
+                    module, call,
+                    f"thread created without `daemon=True` and with no "
+                    f"visible `join()`/daemon lifecycle ({held}) — it "
+                    f"outlives main and hangs interpreter shutdown; pass "
+                    f"`daemon=True` or join it on the shutdown path")
+
+    @staticmethod
+    def _thread_calls(module: ModuleInfo, stmt: ast.stmt):
+        """(Thread(...) call, dotted name it is assigned to | None)."""
+        bound_ids: dict[int, str] = {}
+        if isinstance(stmt, ast.Assign):
+            names = [n for t in stmt.targets for n in assigned_names(t)]
+            if names and isinstance(stmt.value, ast.Call):
+                bound_ids[id(stmt.value)] = names[0]
+            elif names and isinstance(stmt.value, (ast.ListComp, ast.SetComp,
+                                                   ast.GeneratorExp)):
+                # threads built in a comprehension are "bound to" the
+                # container the comprehension is assigned to
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, ast.Call):
+                        bound_ids[id(sub)] = names[0]
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node is not stmt:
+                continue
+            if isinstance(node, ast.Call):
+                canon = module.canonical(node.func) or ""
+                if canon == "threading.Thread" or canon.endswith(".Thread"):
+                    yield node, bound_ids.get(id(node))

@@ -364,7 +364,8 @@ class _ThreadedPrefetch:
 
         self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, size))
         self._stop = threading.Event()
-        self._error: BaseException | None = None
+        self._err_lock = threading.Lock()
+        self._error: BaseException | None = None  # guarded-by: self._err_lock
         self._source = iterable
         self._sharding = sharding
         self.thread = threading.Thread(
@@ -386,7 +387,8 @@ class _ThreadedPrefetch:
                     except Exception:  # queue.Full
                         continue
         except BaseException as e:  # noqa: BLE001 - re-raised at consumer
-            self._error = e
+            with self._err_lock:
+                self._error = e
         finally:
             while not self._stop.is_set():
                 try:
@@ -398,21 +400,31 @@ class _ThreadedPrefetch:
     def __iter__(self):
         return self
 
+    def _take_error(self):
+        """Claim the worker error (swap-out, at most one claimant wins)."""
+        with self._err_lock:
+            err, self._error = self._error, None
+        return err
+
+    def _peek_error(self) -> bool:
+        with self._err_lock:
+            return self._error is not None
+
     def __next__(self):
         while True:
-            if self._error is not None:
-                err, self._error = self._error, None
+            err = self._take_error()
+            if err is not None:
                 self.close()
                 raise err
             try:
                 item = self._q.get(timeout=0.05)
             except Exception:  # queue.Empty — re-check error/stop, wait on
                 if not self.thread.is_alive() and self._q.empty() \
-                        and self._error is None:
+                        and not self._peek_error():
                     raise StopIteration from None
                 continue
             if item is self._DONE:
-                if self._error is not None:
+                if self._peek_error():
                     continue  # surface the error on the next spin
                 self.close()
                 raise StopIteration

@@ -86,22 +86,39 @@ class Completion:
 
 class PendingResult:
     """Caller-facing handle for a submitted request: ``result()`` blocks
-    until the engine completes (or fails) it."""
+    until the engine completes (or fails) it.
+
+    Completion is SINGLE-SHOT: ``_complete``/``_fail`` race each other by
+    design (deadline expiry on the queue side vs. resolution on the
+    engine side, engine shutdown vs. an in-flight eviction), so the first
+    transition wins atomically and every later one is a no-op — a caller
+    can never observe a 504 *and* a completion for the same request."""
 
     def __init__(self, request):
         self.request = request
         self._done = threading.Event()
-        self._value: Any = None
-        self._exc: BaseException | None = None
+        self._lock = threading.Lock()
+        self._value: Any = None              # guarded-by: self._lock
+        self._exc: BaseException | None = None  # guarded-by: self._lock
 
     # -- engine side ----------------------------------------------------
-    def _complete(self, value) -> None:
-        self._value = value
-        self._done.set()
+    def _complete(self, value) -> bool:
+        """Resolve successfully; False when a rival transition won."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._value = value
+            self._done.set()
+        return True
 
-    def _fail(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._done.set()
+    def _fail(self, exc: BaseException) -> bool:
+        """Resolve exceptionally; False when a rival transition won."""
+        with self._lock:
+            if self._done.is_set():
+                return False
+            self._exc = exc
+            self._done.set()
+        return True
 
     # -- caller side ----------------------------------------------------
     def done(self) -> bool:
@@ -170,16 +187,41 @@ class RequestQueue:
                 p = self._items.popleft()
                 dl = p.request.deadline_s
                 if dl is not None and now > dl:
-                    METRICS.increment("serving.deadline_dropped")
-                    p._fail(DeadlineExceeded(
-                        f"request {p.request.id} expired after "
-                        f"{now - p.request.submitted_s:.3f}s in queue"))
+                    if p._fail(DeadlineExceeded(
+                            f"request {p.request.id} expired after "
+                            f"{now - p.request.submitted_s:.3f}s in queue")):
+                        METRICS.increment("serving.deadline_dropped")
                     continue
                 METRICS.observe_time("serving.queue_wait",
                                      now - p.request.submitted_s)
                 out.append(p)
             METRICS.gauge("serving.queue.depth", len(self._items))
         return out
+
+    def claim(self, p: PendingResult) -> bool:
+        """Atomic expiry-vs-admission arbiter (engine side).
+
+        ``take()`` checks deadlines at pop time, but the engine occupies
+        the decode slot later — a deadline expiring in that window used
+        to admit an already-dead request (check-then-act).  The engine
+        now calls ``claim`` at the moment it takes the slot: under the
+        queue lock the request either expires here (completes with
+        :class:`DeadlineExceeded`, never decodes) or is admitted — after
+        a True claim the deadline no longer applies to admission.
+        """
+        with self._cv:
+            if p.done():
+                return False         # already failed (expiry/shutdown)
+            dl = p.request.deadline_s
+            now = time.monotonic()
+            if dl is not None and now > dl:
+                if p._fail(DeadlineExceeded(
+                        f"request {p.request.id} expired after "
+                        f"{now - p.request.submitted_s:.3f}s before "
+                        f"admission")):
+                    METRICS.increment("serving.deadline_dropped")
+                return False
+            return True
 
     def depth(self) -> int:
         with self._cv:

@@ -119,22 +119,27 @@ class InferenceEngine:
             restored = self._ckpt.restore(template, step=step)
             params = restored["params"]
             self._loaded_step = restored["step"]
+        # _lock guards the params swap AND the slot bookkeeping shared
+        # between the serve thread and callers (stop/stats/HTTP handlers);
+        # _state is deliberately OUTSIDE it — serve-thread-owned, see
+        # warmup().  The guarded-by annotations are the LK01 contract:
+        # every non-__init__ write must hold the lock.
+        self._lock = threading.Lock()
         # _raw_params is the unquantized tree (also the reload restore
         # template — checkpoints never contain *_q leaves); _params is
         # what decode actually reads, int8-quantized when opted in
-        self._raw_params = params
-        self._params = self._maybe_quantize(params)
+        self._raw_params = params                # guarded-by: self._lock
+        self._params = self._maybe_quantize(params)  # guarded-by: self._lock
         self._state = self._init_state()
         self._step_fn = jax.jit(self._build_step(), donate_argnums=(1,))
         self._step_compiled = False
-        self._admit_fns: dict[int, Callable] = {}
-        self._slots: dict[int, _Slot] = {}
-        self._free: list[int] = list(range(cfg.slots))
+        self._admit_fns: dict[int, Callable] = {}    # guarded-by: self._lock
+        self._slots: dict[int, _Slot] = {}           # guarded-by: self._lock
+        self._free: list[int] = list(range(cfg.slots))  # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()   # guards _params swap vs. read
-        self._admitted = 0
-        self._completed = 0
+        self._admitted = 0                           # guarded-by: self._lock
+        self._completed = 0                          # guarded-by: self._lock
 
     def _maybe_quantize(self, params):
         """The serving tree decode reads: unchanged by default; with
@@ -211,7 +216,8 @@ class InferenceEngine:
         return min(b, self.model.cfg.max_len)
 
     def _admit_for(self, bucket: int) -> Callable:
-        cached = self._admit_fns.get(bucket)
+        with self._lock:
+            cached = self._admit_fns.get(bucket)
         if cached is not None:
             return cached
         cfg = self.model.cfg
@@ -264,7 +270,8 @@ class InferenceEngine:
             )
 
         prefill = jax.jit(admit, donate_argnums=(1,))
-        self._admit_fns[bucket] = prefill
+        with self._lock:
+            self._admit_fns[bucket] = prefill
         METRICS.increment("serving.prefill.recompile")
         return prefill
 
@@ -318,8 +325,10 @@ class InferenceEngine:
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
-        for s in list(self._slots):
-            self._slots.pop(s).pending._fail(
+        with self._lock:
+            dead = [self._slots.pop(s) for s in list(self._slots)]
+        for sl in dead:
+            sl.pending._fail(
                 RuntimeError("engine stopped with request in flight"))
         for p in self._queue.drain():
             p._fail(RuntimeError("engine stopped before request was admitted"))
@@ -344,7 +353,11 @@ class InferenceEngine:
                        jnp.zeros((bucket,), jnp.int32), jnp.int32(1),
                        jnp.int32(0), jax.random.key(0), jnp.float32(0.0),
                        jnp.int32(0))
-            # the warmup admit occupied slot 0 with a dummy — deactivate
+            # the warmup admit occupied slot 0 with a dummy — deactivate.
+            # graftlint: disable=LK01 — _state is serve-thread-owned (every
+            # other write site runs on the serve loop); warmup runs strictly
+            # before Thread.start(), which is a happens-before edge, so this
+            # external-context write can never race the loop
             self._state = dict(state, active=jnp.zeros_like(state["active"]))
 
     def _serve_loop(self) -> None:
@@ -353,9 +366,11 @@ class InferenceEngine:
                 self._serve_once()
             except Exception as e:  # defensive: a wedged loop strands callers
                 METRICS.increment("serving.engine.errors")
-                for s in list(self._slots):
-                    self._slots.pop(s).pending._fail(e)
-                self._free = list(range(self.cfg.slots))
+                with self._lock:
+                    dead = [self._slots.pop(s) for s in list(self._slots)]
+                    self._free = list(range(self.cfg.slots))
+                for sl in dead:
+                    sl.pending._fail(e)
                 with allow_transfers():
                     self._state = self._init_state()
 
@@ -384,15 +399,21 @@ class InferenceEngine:
 
     def _admit(self, batch: list[PendingResult]) -> None:
         for p in batch:
-            slot = self._free.pop()
+            # atomic expiry-vs-admission: a deadline that passed between
+            # the queue pop and this point 504s HERE, under the queue
+            # lock, instead of occupying a slot to decode tokens nobody
+            # is waiting for
+            if not self._queue.claim(p):
+                continue
             req: GenerateRequest = p.request
+            with self._lock:
+                slot = self._free.pop()
+                params = self._params
             try:
                 bucket = self._prompt_bucket(len(req.prompt))
                 prompt = np.zeros((bucket,), np.int32)
                 prompt[:len(req.prompt)] = req.prompt
                 admit_fn = self._admit_for(bucket)
-                with self._lock:
-                    params = self._params
                 self._state = admit_fn(
                     params, self._state, jnp.asarray(prompt),
                     jnp.int32(len(req.prompt)), jnp.int32(slot),
@@ -401,12 +422,15 @@ class InferenceEngine:
             except Exception as e:
                 # fail only THIS request — the slot goes back to the pool
                 # and the rest of the batch still admits
-                self._free.append(slot)
+                with self._lock:
+                    self._free.append(slot)
                 METRICS.increment("serving.engine.errors")
                 p._fail(e)
                 continue
-            self._slots[slot] = _Slot(pending=p, admitted_s=time.monotonic())
-            self._admitted += 1
+            with self._lock:
+                self._slots[slot] = _Slot(pending=p,
+                                          admitted_s=time.monotonic())
+                self._admitted += 1
             METRICS.increment("serving.admitted")
 
     def _decode_segment(self) -> list:
@@ -470,15 +494,18 @@ class InferenceEngine:
         """Free slot ``s``: complete the caller, drop the host record,
         deactivate the row and wipe its K/V (tokens the segment over-
         decoded past EOS died here, discarded at the fence)."""
-        sl = self._slots.pop(s)
+        with self._lock:
+            sl = self._slots.pop(s)
+            self._free.append(s)
+            self._completed += 1
         mask = np.zeros((self.cfg.slots,), bool)
         mask[s] = True
+        # the freed row is reusable before this wipe lands only by
+        # _admit, which runs on this same serve thread — no interleave
         self._state = dict(
             self._state,
             cache=reset_cache_slots(self._state["cache"], jnp.asarray(mask)),
             active=self._state["active"].at[s].set(False))
-        self._free.append(s)
-        self._completed += 1
         req = sl.pending.request
         METRICS.increment("serving.completed")
         METRICS.observe_time("serving.request_latency", now - req.submitted_s)
@@ -516,17 +543,18 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
-        return {
-            "slots": self.cfg.slots,
-            "active": len(self._slots),
-            "free": len(self._free),
-            "queue_depth": self._queue.depth(),
-            "admitted": self._admitted,
-            "completed": self._completed,
-            "loaded_step": self._loaded_step,
-            "prefill_buckets": sorted(self._admit_fns),
-            "running": self._thread is not None,
-        }
+        with self._lock:
+            return {
+                "slots": self.cfg.slots,
+                "active": len(self._slots),
+                "free": len(self._free),
+                "queue_depth": self._queue.depth(),
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "loaded_step": self._loaded_step,
+                "prefill_buckets": sorted(self._admit_fns),
+                "running": self._thread is not None,
+            }
 
 
 class BatchScorer:
@@ -546,8 +574,9 @@ class BatchScorer:
         self.fn = fn
         self.max_batch = max_batch
         self._queue = RequestQueue(max_queue, max_batch_delay_ms)
-        self._row_shape: tuple | None = None
-        self._row_dtype = None
+        self._shape_lock = threading.Lock()
+        self._row_shape: tuple | None = None  # guarded-by: self._shape_lock
+        self._row_dtype = None                # guarded-by: self._shape_lock
         self._buckets: set[int] = set()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -576,11 +605,14 @@ class BatchScorer:
 
     def submit(self, x) -> PendingResult:
         x = np.asarray(x)
-        if self._row_shape is None:
-            self._row_shape, self._row_dtype = x.shape, x.dtype
-        elif x.shape != self._row_shape:
-            raise ValueError(
-                f"row shape {x.shape} != first-seen {self._row_shape}")
+        # check-then-set must be atomic: two first submitters racing here
+        # could each see None and publish different shapes
+        with self._shape_lock:
+            if self._row_shape is None:
+                self._row_shape, self._row_dtype = x.shape, x.dtype
+            elif x.shape != self._row_shape:
+                raise ValueError(
+                    f"row shape {x.shape} != first-seen {self._row_shape}")
         return self._queue.submit(ScoreRequest(x=x))
 
     def score(self, x, timeout: float = 30.0):

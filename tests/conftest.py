@@ -37,6 +37,13 @@ def pytest_configure(config):
         "jax.transfer_guard('disallow') — any implicit host<->device "
         "transfer inside the test body fails it (hot-loop contract; see "
         "deeplearning4j_tpu/analysis/runtime.py)")
+    config.addinivalue_line(
+        "markers",
+        "lockguard: run the test with instrumented threading locks — "
+        "lock-order inversions and Eraser-style unguarded shared writes "
+        "observed during the test fail it (see "
+        "deeplearning4j_tpu/analysis/lockguard.py); DL4J_TPU_LOCKGUARD=1 "
+        "applies the same check to every test in the session")
 
 
 @pytest.fixture(autouse=True)
@@ -51,6 +58,31 @@ def _transfer_guard_marker(request):
         return
     with jax.transfer_guard("disallow"):
         yield
+
+
+@pytest.fixture(autouse=True)
+def _lockguard_marker(request):
+    """Enforce the ``lockguard`` marker (or ``DL4J_TPU_LOCKGUARD=1``
+    session-wide): threading locks created during the test are
+    instrumented, and any lock-order inversion or unguarded shared write
+    the detector observes fails the test at teardown.  Tests that
+    deliberately provoke violations drive their own ``LockGuard``
+    instance instead of the marker."""
+    from deeplearning4j_tpu.analysis import lockguard as lg
+
+    if request.node.get_closest_marker("lockguard") is None \
+            and not lg.enabled_from_env():
+        yield
+        return
+    lg.LOCKGUARD.reset()
+    lg.LOCKGUARD.install()
+    try:
+        yield
+        violations = lg.LOCKGUARD.violations()
+        assert not violations, lg.LOCKGUARD.report()
+    finally:
+        lg.LOCKGUARD.uninstall()
+        lg.LOCKGUARD.reset()
 
 
 @pytest.fixture

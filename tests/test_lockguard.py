@@ -1,0 +1,340 @@
+"""Runtime race detector (analysis/lockguard.py) unit + integration tests.
+
+The detector tests drive a private ``LockGuard`` instance (install/
+uninstall scoped per test) so deliberate violations never leak into the
+session singleton the ``lockguard`` marker asserts on.  The integration
+half runs real serving traffic with the engine object under Eraser watch
+and pins the concurrency regressions fixed alongside this tier: the
+prefetch worker-error handoff and the scorer shape race.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.analysis.lockguard import (
+    LOCKGUARD,
+    LockGuard,
+    enabled_from_env,
+    lockguard_active,
+)
+
+
+@pytest.fixture
+def guard():
+    g = LockGuard()
+    g.install()
+    yield g
+    g.uninstall()
+
+
+def _run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive()
+
+
+# ------------------------------------------------------------ lock order
+
+def test_lock_order_inversion_detected_without_deadlocking(guard):
+    """Thread 1 takes A then B; thread 2 (run strictly AFTER thread 1
+    finished, so nothing can actually wedge) takes B then A — the cycle
+    in the order graph is reported even though this run never blocked."""
+    a, b = threading.Lock(), threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    _run(ab)
+    _run(ba)
+    kinds = [v.kind for v in guard.violations()]
+    assert kinds == ["lock-order"]
+    assert "inversion" in str(guard.violations()[0])
+
+
+def test_consistent_order_is_clean(guard):
+    a, b = threading.Lock(), threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    _run(ab)
+    _run(ab)
+    assert guard.violations() == []
+
+
+def test_cycle_reported_once_not_per_occurrence(guard):
+    a, b = threading.Lock(), threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for _ in range(3):
+        _run(ab)
+        _run(ba)
+    assert len(guard.violations()) == 1
+
+
+def test_rlock_reentry_is_not_a_self_cycle(guard):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert guard.violations() == []
+
+
+def test_condition_wait_keeps_hold_tracking_truthful(guard):
+    """Condition.wait fully releases its (R)Lock; after the wait the
+    re-acquire must not create phantom order edges or leak held state."""
+    cv = threading.Condition()
+    done = threading.Event()
+
+    def waiter():
+        with cv:
+            cv.wait(0.2)
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join(5.0)
+    assert done.is_set()
+    assert guard.violations() == []
+
+
+# ---------------------------------------------------------------- eraser
+
+def test_unguarded_shared_write_detected(guard):
+    class Box:
+        def __init__(self):
+            self.x = 0
+
+    b = Box()
+    guard.watch(b)
+    b.x = 1                      # owner (this thread)
+    _run(lambda: setattr(b, "x", 2))   # second thread, no lock held
+    kinds = [v.kind for v in guard.violations()]
+    assert kinds == ["unguarded-write"]
+    assert guard.violations()[0].details == ("Box", "x")
+
+
+def test_consistently_locked_write_is_clean(guard):
+    class Box:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.x = 0
+
+    b = Box()
+    guard.watch(b)
+    with b.lock:
+        b.x = 1
+
+    def locked_write():
+        with b.lock:
+            b.x = 2
+
+    _run(locked_write)
+    assert guard.violations() == []
+
+
+def test_exclusive_single_thread_writes_are_clean(guard):
+    class Box:
+        def __init__(self):
+            self.x = 0
+
+    b = Box()
+    guard.watch(b)
+    for i in range(10):          # one thread, no lock — fine forever
+        b.x = i
+    assert guard.violations() == []
+
+
+def test_violation_reported_once_per_field(guard):
+    class Box:
+        def __init__(self):
+            self.x = 0
+
+    b = Box()
+    guard.watch(b)
+    b.x = 1
+    for _ in range(3):
+        _run(lambda: setattr(b, "x", 2))
+    assert len(guard.violations()) == 1
+
+
+def test_unwatch_stops_tracking(guard):
+    class Box:
+        def __init__(self):
+            self.x = 0
+
+    b = Box()
+    guard.watch(b)
+    guard.unwatch(b)
+    b.x = 1
+    _run(lambda: setattr(b, "x", 2))
+    assert guard.violations() == []
+
+
+# ------------------------------------------------------------- lifecycle
+
+def test_install_is_scoped_and_reversible():
+    real = threading.Lock
+    with lockguard_active(LockGuard()) as g:
+        assert threading.Lock is not real
+        assert g.installed
+    assert threading.Lock is real
+
+
+def test_env_switch_parses():
+    import os
+
+    old = os.environ.get("DL4J_TPU_LOCKGUARD")
+    try:
+        os.environ["DL4J_TPU_LOCKGUARD"] = "1"
+        assert enabled_from_env()
+        os.environ["DL4J_TPU_LOCKGUARD"] = "0"
+        assert not enabled_from_env()
+        os.environ.pop("DL4J_TPU_LOCKGUARD")
+        assert not enabled_from_env()
+    finally:
+        if old is not None:
+            os.environ["DL4J_TPU_LOCKGUARD"] = old
+
+
+def test_report_and_metrics_emission(guard):
+    from deeplearning4j_tpu.observability import METRICS
+
+    a, b = threading.Lock(), threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    _run(ab)
+    _run(ba)
+    assert "lock-order" in guard.report()
+    guard.emit_metrics()
+    gauges = METRICS.snapshot()["gauges"]
+    assert gauges["lockguard.violations.lock_order"] == 1
+    assert gauges["lockguard.violations.unguarded_write"] == 0
+
+
+# ------------------------------------------- integration: serving stack
+
+def _tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+
+    cfg = TransformerConfig(vocab_size=31, d_model=16, n_heads=2,
+                            n_layers=1, d_ff=32, max_len=64,
+                            dtype=jnp.float32)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.key(7))
+
+
+@pytest.mark.lockguard
+def test_engine_traffic_clean_under_watch():
+    """Serving traffic with the engine object under Eraser watch AND the
+    session lockguard marker: every rebindable shared field the engine
+    mutates mid-flight must show a consistent lockset (watch is applied
+    after ``start()`` — the warmup handoff is a happens-before edge the
+    lockset algorithm cannot see, see lockguard module docstring)."""
+    from deeplearning4j_tpu.serving import InferenceEngine, ServingConfig
+
+    model, params = _tiny_lm()
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    engine.start()
+    LOCKGUARD.watch(engine)
+    try:
+        outs = [engine.submit([1, 2, 3], 3, seed=i) for i in range(4)]
+        got = [h.result(60.0) for h in outs]
+        assert all(len(o.tokens) == 3 for o in got)
+        assert engine.stats()["completed"] == 4
+    finally:
+        engine.stop()
+        LOCKGUARD.unwatch(engine)
+    # the marker's teardown asserts LOCKGUARD.violations() == []
+
+
+@pytest.mark.lockguard
+def test_scorer_concurrent_first_submits_clean_under_watch():
+    """Regression for the BatchScorer shape race: concurrent FIRST
+    submits from many threads race the ``_row_shape`` check-then-set;
+    it is now atomic under ``_shape_lock``, so the watched scorer stays
+    violation-free and every row scores against one agreed shape."""
+    from deeplearning4j_tpu.serving import BatchScorer
+
+    scorer = BatchScorer(lambda xs: xs * 2.0, max_batch=8)
+    with scorer:
+        LOCKGUARD.watch(scorer)
+        results = []
+        res_lock = threading.Lock()
+
+        def first_submit(i):
+            out = scorer.score(np.full((4,), float(i)), timeout=30.0)
+            with res_lock:
+                results.append(out)
+
+        ts = [threading.Thread(target=first_submit, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30.0)
+        LOCKGUARD.unwatch(scorer)
+    assert len(results) == 6
+    assert all(r.shape == (4,) for r in results)
+
+
+@pytest.mark.lockguard
+def test_threaded_prefetch_worker_error_handoff():
+    """Regression for the ``_ThreadedPrefetch._error`` race: the worker
+    publishes its exception under ``_err_lock`` and the consumer claims
+    it with an atomic swap, so exactly one claimant re-raises — run
+    under the lockguard marker to keep the queue/lock traffic honest."""
+    from deeplearning4j_tpu.datasets.iterator import prefetch_to_device
+
+    def exploding_source():
+        yield np.zeros((2, 2), np.float32)
+        raise RuntimeError("worker boom")
+
+    it = prefetch_to_device(exploding_source(), size=2, host_thread=True)
+    batches = []
+    with pytest.raises(RuntimeError, match="worker boom"):
+        for b in it:
+            batches.append(b)
+    # the error may win the race against the first staged batch — the
+    # contract is "raised exactly once, worker shut down", not ordering
+    assert len(batches) <= 1
+    assert not it.thread.is_alive()

@@ -193,6 +193,7 @@ def _new_trainer(loss_fn):
                                                 T.sgd_lr(5e-2)), mesh=mesh)
 
 
+@pytest.mark.lockguard
 def test_supervised_chaos_parity(tmp_path):
     """The acceptance chain: transient step failure + corrupted checkpoint
     write + data-pipeline failure in one run — the supervisor completes,
@@ -272,6 +273,7 @@ def test_nan_guard_gives_up_after_max_rollbacks(tmp_path):
     assert METRICS.snapshot()["counters"]["resilience.gave_up"] == 1
 
 
+@pytest.mark.lockguard
 def test_injected_preemption_checkpoints_and_resumes(tmp_path):
     params, loss_fn, x, y = _toy_problem()
     data = _batches(x, y)

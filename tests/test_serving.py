@@ -9,6 +9,7 @@ produces for the same (prompt, max_new, temperature, seed) — continuous
 batching is an implementation detail, not a semantics change.
 """
 
+import threading
 import time
 
 import jax
@@ -126,9 +127,12 @@ def test_submit_validation_and_engine_backpressure(lm):
 
 
 # ----------------------------------------------------- continuous batching
+@pytest.mark.lockguard
 def test_continuous_batching_matches_offline_sample(lm):
     """The acceptance test: mixed greedy/temperature traffic through 3
-    concurrent slots is token-identical to the sequential sampler."""
+    concurrent slots is token-identical to the sequential sampler — run
+    with instrumented locks, so a lock-order inversion or unguarded
+    shared write anywhere in the engine/queue path fails it too."""
     model, params = lm
     plans = [([5, 1, 4], 6, 0.0, 0),
              ([2, 8, 2, 8, 2, 8, 2, 8, 2], 4, 0.8, 123),
@@ -395,3 +399,107 @@ def test_http_server_end_to_end(lm):
         with pytest.raises(ServingError) as e404:
             client._json("/v1/nope", {})
         assert e404.value.status == 404
+
+
+# ------------------------------------------------- concurrency regressions
+
+def test_pending_result_completion_is_single_shot():
+    """_complete/_fail race by design (expiry vs. resolution vs. shutdown);
+    exactly one transition wins and the rest are no-ops."""
+    p = RequestQueue().submit(GenerateRequest(prompt=[1], max_new_tokens=1))
+    assert p._fail(DeadlineExceeded("expired")) is True
+    assert p._complete("late value") is False       # rival lost
+    assert p._fail(RuntimeError("also late")) is False
+    with pytest.raises(DeadlineExceeded):           # first transition stuck
+        p.result(0)
+
+
+def test_claim_arbitrates_expiry_vs_admission_under_contention():
+    """Regression for the check-then-act window between take() and slot
+    occupancy: an engine-like thread claims each request at the moment it
+    would take a slot, while deadlines straddle the claim point.  Every
+    request must end in EXACTLY one of {claimed-and-completed, expired} —
+    never both, never neither."""
+    q = RequestQueue(max_depth=256, max_batch_delay_ms=0.0)
+    completed, stop = [], threading.Event()
+
+    def engine_like():
+        while not stop.is_set():
+            for p in q.take(4, block_s=0.01):
+                time.sleep(0.002)        # widen the take->claim window
+                if q.claim(p):
+                    assert p._complete(f"ok-{p.request.id}")
+                    completed.append(p)
+
+    t = threading.Thread(target=engine_like)
+    t.start()
+    handles = []
+    now = time.monotonic
+    for i in range(60):
+        # deadlines scattered tightly around the claim point, some already
+        # dead, some comfortably alive
+        dl = now() + (i % 3 - 1) * 0.004
+        handles.append(q.submit(GenerateRequest(
+            prompt=[1], max_new_tokens=1, deadline_s=dl)))
+    deadline = time.monotonic() + 30.0
+    while not all(h.done() for h in handles):
+        assert time.monotonic() < deadline, "requests stranded"
+        time.sleep(0.005)
+    stop.set()
+    t.join(10.0)
+    assert not t.is_alive()
+
+    outcomes = {"completed": 0, "expired": 0}
+    for h in handles:
+        try:
+            val = h.result(0)
+            assert val == f"ok-{h.request.id}"
+            outcomes["completed"] += 1
+        except DeadlineExceeded:
+            outcomes["expired"] += 1
+    assert sum(outcomes.values()) == len(handles)
+    counters = METRICS.snapshot()["counters"]
+    assert counters.get("serving.deadline_dropped", 0) == outcomes["expired"]
+    assert len(completed) == outcomes["completed"]
+
+
+def test_claim_refuses_already_failed_request():
+    q = RequestQueue()
+    p = q.submit(GenerateRequest(prompt=[1], max_new_tokens=1))
+    p._fail(RuntimeError("shutdown"))
+    assert q.claim(p) is False          # never resurrect a dead request
+
+
+def test_stats_and_stop_race_free_during_traffic(lm):
+    """Callers hammer stats() from several threads while requests flow and
+    the engine shuts down mid-read — slot bookkeeping is lock-consistent:
+    no snapshot ever shows more slots than exist (a slot may be in
+    transit between free and active while its prefill runs, so the sum
+    can briefly undershoot, never overshoot) and nothing throws."""
+    model, params = lm
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    errors, stop = [], threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                s = engine.stats()
+                assert 0 <= s["active"] + s["free"] <= s["slots"]
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                errors.append(e)
+
+    ts = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in ts:
+        t.start()
+    try:
+        with engine:
+            outs = [engine.submit([1, 2, 3], 2, seed=i) for i in range(6)]
+            for h in outs:
+                h.result(60.0)
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(10.0)
+    assert errors == []
+    assert engine.stats()["completed"] == 6

@@ -154,7 +154,13 @@ def run_serving(seed: int) -> dict:
     random submit index and ``serving.decode`` for a random number of
     decode rounds, and assert every completion is STILL token-identical
     to the fault-free ``Transformer.sample`` reference — the engine's
-    skip-and-retry contract (a skipped dispatch leaves state untouched)."""
+    skip-and-retry contract (a skipped dispatch leaves state untouched).
+
+    The whole leg runs under lockguard: injected faults drive the
+    engine's error paths (submit retry, decode skip, eviction on
+    failure), which are exactly the paths the lock discipline is easiest
+    to get wrong on — any lock-order inversion or unguarded shared write
+    observed fails the leg alongside the parity assertion."""
     import jax
     import jax.numpy as jnp
 
@@ -162,6 +168,7 @@ def run_serving(seed: int) -> dict:
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        TransformerLM)
     from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.analysis.lockguard import LockGuard
     from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
     from deeplearning4j_tpu.resilience.faults import FAULTS, InjectedFault
     from deeplearning4j_tpu.serving import InferenceEngine, ServingConfig
@@ -193,21 +200,25 @@ def run_serving(seed: int) -> dict:
                        max_fires=decode_fires),
              FaultSpec("serving.request", at_step=submit_fire_at)]
     submit_faults = 0
-    with inject_faults(*specs, seed=seed):
-        engine = InferenceEngine(
-            model, params=params,
-            cfg=ServingConfig(slots=3, resolve_every=2)).start()
-        handles = []
-        for r in reqs:
-            try:
-                handles.append(engine.submit(**r))
-            except InjectedFault:
-                submit_faults += 1
-                handles.append(engine.submit(**r))   # transient: retry wins
-        outs = [h.result(60.0) for h in handles]
-        engine.stop()
-        fired = {"serving.decode": FAULTS.fire_count("serving.decode"),
-                 "serving.request": FAULTS.fire_count("serving.request")}
+    guard = LockGuard().install()
+    try:
+        with inject_faults(*specs, seed=seed):
+            engine = InferenceEngine(
+                model, params=params,
+                cfg=ServingConfig(slots=3, resolve_every=2)).start()
+            handles = []
+            for r in reqs:
+                try:
+                    handles.append(engine.submit(**r))
+                except InjectedFault:
+                    submit_faults += 1
+                    handles.append(engine.submit(**r))  # transient: retry wins
+            outs = [h.result(60.0) for h in handles]
+            engine.stop()
+            fired = {"serving.decode": FAULTS.fire_count("serving.decode"),
+                     "serving.request": FAULTS.fire_count("serving.request")}
+    finally:
+        guard.uninstall()
 
     parity = all(o.tokens == e for o, e in zip(outs, expected))
     result = {
@@ -217,10 +228,12 @@ def run_serving(seed: int) -> dict:
         "decode_faults_fired": fired["serving.decode"],
         "submit_faults_fired": fired["serving.request"],
         "submit_retries": submit_faults,
+        "lockguard_violations": len(guard.violations()),
     }
     assert parity, f"seed {seed}: served tokens diverged under injection"
     assert fired["serving.decode"] == decode_fires, result
     assert fired["serving.request"] == 1 and submit_faults == 1, result
+    assert not guard.violations(), guard.report()
     return result
 
 

@@ -6,6 +6,7 @@ Usage (from the repo root):
     python -m tools.graftlint [PATHS...]             # report everything
     python -m tools.graftlint --json [PATHS...]      # machine-readable
     python -m tools.graftlint --write-baseline       # accept current state
+    python -m tools.graftlint --rules                # list every rule
 
 Defaults: PATHS = ``deeplearning4j_tpu``, baseline =
 ``graftlint.baseline.json`` at the repo root.  ``--check`` exits 1 when
@@ -46,7 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="AST-based JAX/TPU hazard analyzer (HS01 host syncs, "
                     "RC01 recompiles, RNG01 key reuse, DON01 use-after-"
                     "donate, TB01 traced branches, HOT02 uninstrumented "
-                    "hot loops)")
+                    "hot loops, LK01-LK03/TH01 concurrency; bare --rules "
+                    "prints the full table)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to analyze (default: deeplearning4j_tpu)")
     p.add_argument("--check", action="store_true",
@@ -64,14 +66,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="text mode: show suppressed/baselined findings too")
     p.add_argument("--no-metrics", action="store_true",
                    help="skip publishing graftlint.violations.* gauges")
-    p.add_argument("--rules", default=None,
-                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--rules", nargs="?", const="", default=None,
+                   help="comma-separated rule ids to run (default: all); "
+                        "bare --rules lists every registered rule and exits")
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     paths = args.paths or [os.path.join(_REPO_ROOT, "deeplearning4j_tpu")]
+
+    if args.rules == "":          # bare --rules: print the registry
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}  {rule.title}")
+        return 0
 
     rules = None
     if args.rules:

@@ -11,9 +11,15 @@ would see them, so this doubles as an end-to-end check that the serving
 histograms land.
 
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
+                                  [--lockguard]
 
-Exits nonzero if any request fails or the registry is missing a serving
-histogram.
+``--lockguard`` runs the whole smoke with instrumented threading locks
+(analysis/lockguard.py): lock-order inversions and Eraser-style unguarded
+shared writes observed anywhere in the engine/queue/HTTP path fail the
+run, and the violation count lands in the JSON result.
+
+Exits nonzero if any request fails, the registry is missing a serving
+histogram, or lockguard saw a violation.
 """
 
 from __future__ import annotations
@@ -24,7 +30,8 @@ import sys
 import threading
 
 
-def run(requests: int = 32, threads: int = 4, seed: int = 0) -> dict:
+def run(requests: int = 32, threads: int = 4, seed: int = 0,
+        lockguard: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -38,6 +45,12 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0) -> dict:
 
     observability.enable()
     METRICS.reset()
+
+    guard = None
+    if lockguard:
+        from deeplearning4j_tpu.analysis.lockguard import LockGuard
+
+        guard = LockGuard().install()
 
     cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
                             d_ff=64, max_len=64, dtype=jnp.float32,
@@ -95,6 +108,12 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0) -> dict:
         health = client.healthz()
         prom = client.metrics_prom()
 
+    if guard is not None:
+        guard.uninstall()
+        guard.emit_metrics()
+        for v in guard.violations():
+            failures.append(str(v))
+
     snap = METRICS.snapshot()
     timers, gauges = snap["timers"], snap["gauges"]
 
@@ -125,6 +144,8 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0) -> dict:
         "missing_histograms": missing,
         "failures": failures[:5],
     }
+    if guard is not None:
+        result["lockguard_violations"] = len(guard.violations())
     assert not failures, failures[:5]
     assert not missing, f"registry missing serving histograms: {missing}"
     assert result["completed"] == requests
@@ -137,7 +158,8 @@ def main(argv: list[str]) -> int:
 
     print(json.dumps(run(requests=arg("--requests", 32),
                          threads=arg("--threads", 4),
-                         seed=arg("--seed", 0))))
+                         seed=arg("--seed", 0),
+                         lockguard="--lockguard" in argv)))
     return 0
 
 
