@@ -961,7 +961,8 @@ def _kernel_picks():
     for kind, incumbent in (("attention", "ring"),
                             ("layernorm_residual", "unfused"),
                             ("xent", "scan"),
-                            ("int8_matmul", "f32")):
+                            ("int8_matmul", "f32"),
+                            ("paged_attention", "gather")):
         try:
             table[kind] = kernel_registry.autopick(
                 kind, rows, incumbent=incumbent).as_dict()

@@ -1,4 +1,4 @@
-"""The graftlint rule set — thirteen hazard classes from this repo's history.
+"""The graftlint rule set — fourteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -34,6 +34,9 @@
 | TH01  | `threading.Thread` created with neither `daemon=True` nor a      |
 |       | visible `join()`/daemon-flag lifecycle — leaks a thread that     |
 |       | can hang interpreter shutdown                                    |
+| PG01  | KV page acquire (`alloc`/`incref`/`lookup_prefix` on a page      |
+|       | pool, `serving/` modules) with no `decref`-style release on the  |
+|       | exceptional exit paths — leaked pinned pages 429 the pool        |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -1047,3 +1050,89 @@ class ThreadLifecycleRule(Rule):
                 canon = module.canonical(node.func) or ""
                 if canon == "threading.Thread" or canon.endswith(".Thread"):
                     yield node, bound_ids.get(id(node))
+
+
+#: PagePool methods that hand the caller page references it must release
+_PG_ACQUIRE = {"alloc", "incref", "lookup_prefix"}
+#: methods that give references back (any one on an exit path clears PG01)
+_PG_RELEASE = {"decref", "free", "release", "reset"}
+
+
+@register
+class PageLeakRule(Rule):
+    """PG01: KV pages acquired from a page pool with no release on the
+    failure exit paths.
+
+    The paged serving engine's pages are refcounted host-side
+    (serving/paging.py): every ``alloc``/``lookup_prefix``/``incref``
+    hands the caller references it MUST give back with ``decref`` on
+    every exit path — including the exceptional ones.  A bare acquire
+    that can unwind past its caller leaks pinned pages: the pool's free
+    list shrinks permanently and admission starts 429ing long before the
+    device pool is actually full (the refcount twin of a file-descriptor
+    leak).  The engine's own discipline is acquire-inside-``try`` with
+    ``decref`` in the handler or ``finally`` (see ``_admit``/``warmup``).
+
+    Fires on an acquire-method call whose receiver looks pool-ish (its
+    dotted name mentions ``pool``/``paging``) when no enclosing ``try``
+    has a release call in its handlers or ``finally``.  Scoped to
+    ``serving/`` modules — that is where the pool contract lives.
+    ``self.<acquire>`` is exempt: those are the pool's own internals,
+    whose invariants the pool lock already owns.
+
+    Blind spots: a pool aliased to a name without ``pool`` in it; a
+    release performed by a callee the handler delegates to (name the
+    release in the handler, or silence with a reason).
+    """
+
+    id = "PG01"
+    title = "KV page acquire without release on exit paths"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if "serving/" not in module.path.replace("\\", "/"):
+            return
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _PG_ACQUIRE):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            low = recv.lower()
+            if recv == "self" or not ("pool" in low or "paging" in low):
+                continue
+            if self._released_on_unwind(node, parents):
+                continue
+            yield self.finding(
+                module, node,
+                f"`{recv}.{node.func.attr}` acquires KV page references "
+                "with no release on the exceptional exit path — an "
+                "unwind here leaks pinned pages and the pool 429s "
+                "forever after; wrap in try/except-or-finally that "
+                "`decref`s what was acquired")
+
+    @staticmethod
+    def _released_on_unwind(call: ast.Call, parents) -> bool:
+        """True when an enclosing ``try`` releases pages in a handler or
+        ``finally`` (walking out stops at the enclosing function)."""
+        node: ast.AST = call
+        while True:
+            parent = parents.get(id(node))
+            if parent is None or isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+                return False
+            if isinstance(parent, ast.Try):
+                cleanup = list(parent.finalbody)
+                for h in parent.handlers:
+                    cleanup.extend(h.body)
+                for stmt in cleanup:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) \
+                                and isinstance(sub.func, ast.Attribute) \
+                                and sub.func.attr in _PG_RELEASE:
+                            return True
+            node = parent

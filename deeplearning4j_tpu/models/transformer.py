@@ -421,6 +421,49 @@ def init_decode_cache(cfg: TransformerConfig, batch: int = 1) -> list:
             for _ in range(cfg.n_layers)]
 
 
+def _decode_attend(params, x, valid, write_kv, cfg: TransformerConfig,
+                   attend=None):
+    """Shared per-row decode arithmetic over already-embedded queries
+    ``x`` (N, D): every einsum/softmax below is byte-for-byte the op the
+    single-step decode path has always run, only at a different leading
+    batch size — the bitwise-parity anchor for the paged and windowed
+    variants (DESIGN.md §17).  ``write_kv(layer_idx, k, v) -> (ck, cv)``
+    commits the new K/V wherever the caller keeps it (dense row, page
+    pool) and returns the ``(N, T, H, Dh)`` view attention reads.
+    ``attend(layer_idx, q)`` optionally replaces the gather-read
+    attention (the paged-attention kernel hook); numerics then carry that
+    candidate's tolerance instead of bitwise parity."""
+    dt = cfg.dtype
+    scale = cfg.head_dim ** -0.5
+    for li, lp in enumerate(params["layers"]):
+        h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
+        qkv = jnp.einsum("bd,dshe->bshe", h.astype(dt), lp["wqkv"].astype(dt))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]               # (N, H, Dh)
+        ck, cv = write_kv(li, k, v)
+        if attend is not None:
+            att = attend(li, q)
+        else:
+            s = jnp.einsum("bhd,bthd->bht", q, ck,
+                           preferred_element_type=jnp.float32) * scale
+            s = jnp.where(valid[:, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bht,bthd->bhd", p.astype(dt), cv,
+                             preferred_element_type=jnp.float32).astype(dt)
+        proj = jnp.einsum("bhe,hed->bd", att, lp["wo"].astype(dt))
+        x = x + proj.astype(x.dtype)
+        h2 = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
+        down = _ffn(lp, h2, dt) + lp["b2"].astype(dt)
+        x = x + down.astype(x.dtype)
+    h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
+    if "head_q" in params:
+        # int8-quantized serving tree (quantize_params_for_decode): the
+        # LM head streams as int8 + per-channel scales, logits f32
+        from ..ops.pallas.matmul_int8 import int8_matmul
+        return int8_matmul(h.astype(dt), params["head_q"])
+    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
+    return (h.astype(dt) @ head.astype(dt)).astype(jnp.float32)
+
+
 def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     """One incremental decode step: ``tokens`` (B,) are the ids at
     position ``pos`` — a traced scalar (every row at the same depth: the
@@ -437,38 +480,20 @@ def decode_step(params, cache, tokens, pos, cfg: TransformerConfig):
     pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), tokens.shape)  # (B,)
     x = (jnp.take(params["tok_embed"], tokens, axis=0)
          + jnp.take(params["pos_embed"], pos_b, axis=0)).astype(dt)  # (B, D)
-    scale = cfg.head_dim ** -0.5
     valid = jnp.arange(cfg.max_len)[None, :] <= pos_b[:, None]       # (B, T)
     # per-row cache write: row b's K/V lands at its OWN position pos_b[b]
     upd = jax.vmap(
         lambda c, kv, p: lax.dynamic_update_slice_in_dim(c, kv[None], p, axis=0))
-    new_cache = []
-    for lp, c in zip(params["layers"], cache):
-        h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
-        qkv = jnp.einsum("bd,dshe->bshe", h.astype(dt), lp["wqkv"].astype(dt))
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]               # (B, H, Dh)
-        ck = upd(c["k"], k, pos_b)
-        cv = upd(c["v"], v, pos_b)
+    new_cache: list = []
+
+    def write_kv(li, k, v):
+        ck = upd(cache[li]["k"], k, pos_b)
+        cv = upd(cache[li]["v"], v, pos_b)
         new_cache.append({"k": ck, "v": cv})
-        s = jnp.einsum("bhd,bthd->bht", q, ck,
-                       preferred_element_type=jnp.float32) * scale
-        s = jnp.where(valid[:, None, :], s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        att = jnp.einsum("bht,bthd->bhd", p.astype(dt), cv,
-                         preferred_element_type=jnp.float32).astype(dt)
-        proj = jnp.einsum("bhe,hed->bd", att, lp["wo"].astype(dt))
-        x = x + proj.astype(x.dtype)
-        h2 = _layernorm(x, lp["ln2_scale"], lp["ln2_bias"])
-        down = _ffn(lp, h2, dt) + lp["b2"].astype(dt)
-        x = x + down.astype(x.dtype)
-    h = _layernorm(x, params["final_ln_scale"], params["final_ln_bias"])
-    if "head_q" in params:
-        # int8-quantized serving tree (quantize_params_for_decode): the
-        # LM head streams as int8 + per-channel scales, logits f32
-        from ..ops.pallas.matmul_int8 import int8_matmul
-        return int8_matmul(h.astype(dt), params["head_q"]), new_cache
-    head = (params["tok_embed"].T if cfg.tie_embeddings else params["lm_head"])
-    return (h.astype(dt) @ head.astype(dt)).astype(jnp.float32), new_cache
+        return ck, cv
+
+    logits = _decode_attend(params, x, valid, write_kv, cfg)
+    return logits, new_cache
 
 
 def reset_cache_slots(cache, slot_mask) -> list:
@@ -480,6 +505,190 @@ def reset_cache_slots(cache, slot_mask) -> list:
     def wipe(c):
         return jnp.where(slot_mask[:, None, None, None], jnp.zeros_like(c), c)
     return [{"k": wipe(c["k"]), "v": wipe(c["v"])} for c in cache]
+
+
+# --------------------------------------------------------------------------- paged KV decode
+
+def init_paged_cache(cfg: TransformerConfig, num_pages: int,
+                     page_size: int) -> list:
+    """Per-layer paged K/V pools: ``(num_pages, page_size, H, Dh)`` keys
+    and values shared by ALL serving slots, addressed through per-slot
+    block tables instead of a dense per-slot row (DESIGN.md §17).  The
+    caller typically sizes ``num_pages`` with one extra trash page whose
+    index is parked in the block-table rows of inactive slots."""
+    shape = (num_pages, page_size, cfg.n_heads, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.n_layers)]
+
+
+def reset_cache_pages(pages, page_mask) -> list:
+    """Zero the physical pages named by ``page_mask`` (P,) bool — the
+    paged twin of :func:`reset_cache_slots`: eviction hygiene for pages
+    whose refcount just reached zero (never for aliased pages)."""
+    def wipe(c):
+        return jnp.where(page_mask[:, None, None, None], jnp.zeros_like(c), c)
+    return [{"k": wipe(c["k"]), "v": wipe(c["v"])} for c in pages]
+
+
+def paged_flat_index(block_table, positions, page_size: int):
+    """Flatten logical positions to indices into a ``(P*page_size, ...)``
+    view of the page pool: ``block_table`` (B, n_pages), ``positions``
+    (B, W) → ``bt[b, t // ps] * ps + t % ps`` (B, W).  Page lookups are
+    clamped to the table; callers mask out-of-range positions themselves
+    (scatters use ``mode="drop"`` sentinels)."""
+    n_pages = block_table.shape[1]
+    page = jnp.minimum(positions // page_size, n_pages - 1)
+    return (jnp.take_along_axis(block_table, page, axis=1) * page_size
+            + positions % page_size)
+
+
+def gather_paged_kv(c, block_table, max_len: int):
+    """Materialize one logical ``(B, max_len, H, Dh)`` K/V view from the
+    page pool ``c`` (P, ps, H, Dh) through ``block_table`` (B, n_pages).
+    The gathered buffer has EXACTLY the dense cache's shape, so running
+    ``decode_step``'s attention over it is bitwise the dense computation
+    whenever the gathered content matches (the §17 parity argument —
+    garbage beyond ``pos`` is masked to -inf and contributes exactly 0)."""
+    ps = c.shape[1]
+    B = block_table.shape[0]
+    t = jnp.broadcast_to(jnp.arange(max_len, dtype=jnp.int32)[None, :],
+                         (B, max_len))
+    flat = paged_flat_index(block_table, t, ps)
+    return c.reshape((-1,) + c.shape[2:])[flat]
+
+
+def decode_step_paged(params, pages, block_tables, tokens, pos,
+                      cfg: TransformerConfig, attn_fn=None):
+    """Paged twin of :func:`decode_step`: K/V live in the shared page
+    pool and each row reads/writes through its block-table row.  The new
+    K/V is scattered to page ``bt[b, pos // ps]`` BEFORE attending (same
+    write-then-read order as the dense path), then attention runs over a
+    gather of the row's logical ``[0, max_len)`` K/V — an exactly
+    ``(B, max_len)`` buffer through :func:`_decode_attend`, so logits are
+    bitwise ``decode_step``'s given equal cache content.  ``attn_fn``
+    optionally swaps the gather+softmax read for a registry candidate
+    ``(q, k_pages, v_pages, block_tables, lengths) -> (B, H, Dh)`` (the
+    bench-autopick perf path; numerics then carry that candidate's
+    tolerance).  Returns ``(logits (B, V) f32, new_pages)``."""
+    dt = cfg.dtype
+    ps = pages[0]["k"].shape[1]
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), tokens.shape)  # (B,)
+    x = (jnp.take(params["tok_embed"], tokens, axis=0)
+         + jnp.take(params["pos_embed"], pos_b, axis=0)).astype(dt)
+    valid = jnp.arange(cfg.max_len)[None, :] <= pos_b[:, None]
+    flat = paged_flat_index(block_tables, pos_b[:, None], ps)[:, 0]      # (B,)
+    new_pages: list = []
+
+    def write_kv(li, k, v):
+        c = pages[li]
+        pk = c["k"].reshape((-1,) + c["k"].shape[2:]).at[flat].set(
+            k).reshape(c["k"].shape)
+        pv = c["v"].reshape((-1,) + c["v"].shape[2:]).at[flat].set(
+            v).reshape(c["v"].shape)
+        new_pages.append({"k": pk, "v": pv})
+        if attn_fn is not None:
+            return pk, pv
+        ck = gather_paged_kv(pk, block_tables, cfg.max_len)
+        cv = gather_paged_kv(pv, block_tables, cfg.max_len)
+        return ck, cv
+
+    attend = None
+    if attn_fn is not None:
+        def attend(li, q):
+            pk, pv = new_pages[li]["k"], new_pages[li]["v"]
+            return attn_fn(q, pk, pv, block_tables, pos_b + 1).astype(dt)
+
+    logits = _decode_attend(params, x, valid, write_kv, cfg, attend=attend)
+    return logits, new_pages
+
+
+def decode_window(params, cache, tokens, pos, cfg: TransformerConfig):
+    """Speculative verify window: process ``tokens`` (B, W) at positions
+    ``pos[b] .. pos[b]+W-1`` in ONE dispatch, returning logits for every
+    window position.  Per row this is bitwise identical to W sequential
+    ``decode_step`` calls: the window folds into the leading batch dim
+    (N = B*W) so every matmul/softmax is the same op the single-step path
+    runs (batch-size independence of those ops is what the engine's
+    B=1-offline vs B=S parity already rests on), and window position w's
+    validity mask admits exactly the K/V a sequential step at ``pos+w``
+    would see — all W writes land before any of them is read, and a
+    write at position p is masked out of every query with ``pos+w < p``.
+    Positions past ``max_len-1`` become dropped scatters (never clamped
+    onto a live row).  Returns ``(logits (B, W, V) f32, new_cache)``."""
+    dt = cfg.dtype
+    B, W = tokens.shape
+    T = cfg.max_len
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    wpos = pos_b[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]   # (B, W)
+    ok = wpos < T
+    pos2 = jnp.minimum(wpos, T - 1).reshape(B * W)
+    tok2 = tokens.reshape(B * W)
+    x = (jnp.take(params["tok_embed"], tok2, axis=0)
+         + jnp.take(params["pos_embed"], pos2, axis=0)).astype(dt)
+    valid = jnp.arange(T)[None, :] <= pos2[:, None]                   # (N, T)
+    row = jnp.arange(B, dtype=jnp.int32)[:, None]
+    flat = jnp.where(ok, row * T + wpos, B * T).reshape(B * W)        # drop OOB
+    new_cache: list = []
+
+    def write_kv(li, k, v):
+        c = cache[li]
+        ck = c["k"].reshape((B * T,) + c["k"].shape[2:]).at[flat].set(
+            k, mode="drop").reshape(c["k"].shape)
+        cv = c["v"].reshape((B * T,) + c["v"].shape[2:]).at[flat].set(
+            v, mode="drop").reshape(c["v"].shape)
+        new_cache.append({"k": ck, "v": cv})
+        ck2 = jnp.broadcast_to(ck[:, None], (B, W) + ck.shape[1:]).reshape(
+            (B * W,) + ck.shape[1:])
+        cv2 = jnp.broadcast_to(cv[:, None], (B, W) + cv.shape[1:]).reshape(
+            (B * W,) + cv.shape[1:])
+        return ck2, cv2
+
+    logits = _decode_attend(params, x, valid, write_kv, cfg)
+    return logits.reshape(B, W, -1), new_cache
+
+
+def decode_window_paged(params, pages, block_tables, tokens, pos,
+                        cfg: TransformerConfig):
+    """Paged twin of :func:`decode_window`: the W window writes scatter
+    into the page pool through the block table (out-of-range window
+    positions become dropped sentinel scatters), then each window query
+    attends a gather of its row's logical K/V — same shapes, same ops,
+    same masks as the dense window, so the §17 parity argument carries
+    over unchanged.  Returns ``(logits (B, W, V) f32, new_pages)``."""
+    dt = cfg.dtype
+    B, W = tokens.shape
+    T = cfg.max_len
+    ps = pages[0]["k"].shape[1]
+    n_phys = pages[0]["k"].shape[0]
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    wpos = pos_b[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]   # (B, W)
+    ok = wpos < T
+    pos2 = jnp.minimum(wpos, T - 1).reshape(B * W)
+    tok2 = tokens.reshape(B * W)
+    x = (jnp.take(params["tok_embed"], tok2, axis=0)
+         + jnp.take(params["pos_embed"], pos2, axis=0)).astype(dt)
+    valid = jnp.arange(T)[None, :] <= pos2[:, None]
+    flat = jnp.where(ok, paged_flat_index(block_tables, wpos, ps),
+                     n_phys * ps).reshape(B * W)                      # drop OOB
+    new_pages: list = []
+
+    def write_kv(li, k, v):
+        c = pages[li]
+        pk = c["k"].reshape((-1,) + c["k"].shape[2:]).at[flat].set(
+            k, mode="drop").reshape(c["k"].shape)
+        pv = c["v"].reshape((-1,) + c["v"].shape[2:]).at[flat].set(
+            v, mode="drop").reshape(c["v"].shape)
+        new_pages.append({"k": pk, "v": pv})
+        ck = gather_paged_kv(pk, block_tables, T)
+        cv = gather_paged_kv(pv, block_tables, T)
+        ck2 = jnp.broadcast_to(ck[:, None], (B, W) + ck.shape[1:]).reshape(
+            (B * W,) + ck.shape[1:])
+        cv2 = jnp.broadcast_to(cv[:, None], (B, W) + cv.shape[1:]).reshape(
+            (B * W,) + cv.shape[1:])
+        return ck2, cv2
+
+    logits = _decode_attend(params, x, valid, write_kv, cfg)
+    return logits.reshape(B, W, -1), new_pages
 
 
 def encode_local(params, tokens, cfg: TransformerConfig, *,

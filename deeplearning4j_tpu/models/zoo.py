@@ -105,3 +105,32 @@ def lenet(n_classes: int = 10, *, input_side: int = 28, channels: int = 1,
         confs=(conv_conf, dense_conf, out_conf), pretrain=False,
         preprocessors={0: "flatten"})  # conv output -> dense input
     return MultiLayerNetwork(conf)
+
+
+def draft_lm(target_cfg, *, n_layers: int = 1, width_divisor: int = 2,
+             seed: int = 0):
+    """Zoo recipe for a speculative-decoding draft: a shallower, thinner
+    ``TransformerLM`` sharing the target's ``vocab_size``/``max_len``
+    (the :class:`~..serving.engine.InferenceEngine` compatibility
+    contract — the draft proposes token ids the target verifies, and its
+    KV cache is indexed by the same positions).  Returns
+    ``(model, params)``; train the params or use them as-is — a bad
+    draft only lowers ``serving.spec_accept_len``, never changes served
+    tokens.
+    """
+    import dataclasses as _dc
+
+    import jax as _jax
+
+    from .transformer import TransformerLM
+
+    heads = max(1, target_cfg.n_heads // width_divisor)
+    d_model = max(heads * (target_cfg.d_model // target_cfg.n_heads),
+                  target_cfg.d_model // width_divisor)
+    d_model -= d_model % heads
+    cfg = _dc.replace(
+        target_cfg, n_layers=max(1, n_layers), d_model=d_model,
+        n_heads=heads, d_ff=max(d_model, target_cfg.d_ff // width_divisor),
+        remat=False)
+    model = TransformerLM(cfg)
+    return model, model.init(_jax.random.key(seed))

@@ -1,0 +1,156 @@
+"""Paged-attention decode kernel: K/V read through block tables.
+
+The serving engine's paged decode (DESIGN.md §17) stores K/V as
+fixed-size pages in a ``(num_pages, page_size, H, Dh)`` pool and
+addresses each sequence through an ``(B, n_pages)`` block table.  The
+exact-parity read path gathers a row's logical K/V into a dense
+``(B, max_len, H, Dh)`` buffer and reuses the dense attention ops —
+bitwise, but it materializes max_len per row per layer.  This module's
+Pallas candidate streams the pages instead: one program per
+(sequence, page), the block table SCALAR-PREFETCHED so each program's
+K/V block is DMA'd straight from its physical page, a running softmax
+in VMEM scratch across the page axis.  No (B, max_len) intermediate is
+ever built.
+
+Like every kernel in this tier it enters production only through the
+bench auto-pick gate: :func:`reference_paged_attention` (pure jnp, the
+same gather the engine's parity path uses) is both the incumbent
+candidate ("gather", source="xla") and the correctness reference the
+TUNE battery checks the Pallas candidate against.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..flash_attention import _VMEM, pltpu
+
+from . import registry
+
+_NEG_INF = -1e30
+
+
+def reference_paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                              **_):
+    """Ground truth: gather each row's pages to a dense (B, T, H, Dh)
+    view and run the dense decode attention ops over it.
+
+    ``q`` (B, H, Dh) single-position queries, ``k_pages``/``v_pages``
+    (P, ps, H, Dh), ``block_tables`` (B, n_pages) physical page ids,
+    ``lengths`` (B,) valid K/V prefix per row (>= 1).  Returns
+    (B, H, Dh) in ``q``'s dtype.  These are byte-for-byte the engine's
+    masked-gather attention ops, so this reference IS the parity path.
+    """
+    ps = k_pages.shape[1]
+    B = q.shape[0]
+    T = block_tables.shape[1] * ps
+    scale = q.shape[-1] ** -0.5
+    t = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    flat = jnp.take_along_axis(block_tables, t // ps, axis=1) * ps + t % ps
+    k = k_pages.reshape((-1,) + k_pages.shape[2:])[flat]     # (B, T, H, Dh)
+    v = v_pages.reshape((-1,) + v_pages.shape[2:])[flat]
+    s = jnp.einsum("bhd,bthd->bht", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where((t < lengths[:, None])[:, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bthd->bhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size: int, n_pages: int,
+                  scale: float):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale                 # (H, Dh)
+    k = k_ref[0].astype(jnp.float32)                         # (ps, H, Dh)
+    v = v_ref[0].astype(jnp.float32)
+    s = jnp.sum(q[None, :, :] * k, axis=-1).T                # (H, ps)
+    pos = j * page_size + lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1)                        # (1, ps)
+    mask = pos < len_ref[b]
+    s = jnp.where(mask, s, _NEG_INF)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    # a fully-masked page leaves m_new at -inf; zero its weights
+    # explicitly so exp(-inf - -inf) == 1 cannot leak into the sum
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)    # (H, ps)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[:, 0] = l_ref[:, 0] * corr + p.sum(axis=1)
+    acc_ref[...] = (acc_ref[...] * corr[:, None]
+                    + jnp.sum(p.T[:, :, None] * v, axis=0))  # (H, Dh)
+    m_ref[:, 0] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    interpret: bool | None = None):
+    """Pallas paged decode attention; same signature/result contract as
+    :func:`reference_paged_attention` (within the registered tolerance —
+    running softmax reassociates the reduction, so NOT bitwise).
+    ``interpret=None`` auto-selects Pallas interpret mode off-TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, H, Dh = q.shape
+    ps = k_pages.shape[1]
+    n_pages = block_tables.shape[1]
+    scale = Dh ** -0.5
+    kernel = functools.partial(_paged_kernel, page_size=ps, n_pages=n_pages,
+                               scale=scale)
+    mem = {} if _VMEM is None else {"memory_space": _VMEM}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, H, Dh), lambda b, j, bt, ln: (b, 0, 0), **mem),
+            # the paged read itself: this program's K/V block is whatever
+            # physical page the scalar-prefetched table names
+            pl.BlockSpec((1, ps, H, Dh),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0), **mem),
+            pl.BlockSpec((1, ps, H, Dh),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, H, Dh), lambda b, j, bt, ln: (b, 0, 0),
+                               **mem),
+        scratch_shapes=[
+            pltpu.VMEM((H, Dh), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+registry.register(registry.KernelCandidate(
+    kind="paged_attention", name="pallas", fn=paged_attention,
+    reference=reference_paged_attention,
+    blocks=({},),              # the page size IS the block: nothing to sweep
+    tolerances={"max_err": 0.05},
+))
+
+registry.register(registry.KernelCandidate(
+    kind="paged_attention", name="gather", fn=reference_paged_attention,
+    reference=reference_paged_attention, source="xla",
+))

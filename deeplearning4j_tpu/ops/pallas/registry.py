@@ -38,6 +38,7 @@ _KERNEL_MODULES = (
     "deeplearning4j_tpu.ops.pallas.layernorm",
     "deeplearning4j_tpu.ops.pallas.xent",
     "deeplearning4j_tpu.ops.pallas.matmul_int8",
+    "deeplearning4j_tpu.ops.pallas.paged_attention",
     "deeplearning4j_tpu.ops.flash_attention",
 )
 

@@ -35,6 +35,16 @@ site                   effect when armed
                        transient decode hiccup; engine state is untouched
                        and the next round retries, so completions stay
                        token-identical
+``serving.page_pool``  paged-KV admission behaves as if the page pool were
+                       exhausted (``InferenceEngine._admit``, via
+                       ``FAULTS.check``) — the request is rejected with
+                       :class:`serving.PagePoolExhausted` (HTTP 429) and
+                       no page leaks; in-flight slots keep decoding
+``serving.draft``      the speculative draft model's proposals are garbled
+                       for one verify window (``InferenceEngine``, via
+                       ``FAULTS.check``) — accept length degrades but
+                       emitted tokens stay target-drawn and token parity
+                       holds (the rejection-sampling safety argument)
 =====================  =====================================================
 
 Arming:

@@ -10,10 +10,11 @@ thing over stdlib HTTP with Prometheus metrics.  See DESIGN.md §13.
 """
 
 from .batcher import (Completion, DeadlineExceeded, GenerateRequest,
-                      PendingResult, QueueFull, RequestQueue, ScoreRequest,
-                      ServingRejected)
+                      PagePoolExhausted, PendingResult, QueueFull,
+                      RequestQueue, ScoreRequest, ServingRejected)
 from .client import ServingClient, ServingError
 from .engine import BatchScorer, InferenceEngine, ServingConfig
+from .paging import PagePool
 from .server import ModelServer
 
 __all__ = [
@@ -23,6 +24,8 @@ __all__ = [
     "GenerateRequest",
     "InferenceEngine",
     "ModelServer",
+    "PagePool",
+    "PagePoolExhausted",
     "PendingResult",
     "QueueFull",
     "RequestQueue",

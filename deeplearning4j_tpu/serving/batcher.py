@@ -46,6 +46,15 @@ class DeadlineExceeded(ServingRejected):
     status = 504
 
 
+class PagePoolExhausted(ServingRejected):
+    """The paged KV pool cannot cover a new sequence even after evicting
+    every unpinned prefix-cache entry — admission backpressure, not a
+    crash: the request is rejected (HTTP 429) and in-flight slots keep
+    decoding; retry when slots drain and their pages free."""
+
+    status = 429
+
+
 _REQ_IDS = itertools.count(1)
 
 
@@ -142,6 +151,7 @@ class RequestQueue:
         self.max_batch_delay_ms = max_batch_delay_ms
         self._cv = threading.Condition()
         self._items: deque[PendingResult] = deque()
+        self._woken = False              # guarded-by: self._cv
 
     def submit(self, request) -> PendingResult:
         """Enqueue or reject — never blocks the submitter."""
@@ -163,10 +173,13 @@ class RequestQueue:
         """Up to ``max_n`` admissible requests.
 
         ``block_s > 0`` is the IDLE path: wait up to ``block_s`` for a
-        first arrival, then hold it up to ``max_batch_delay_ms`` for
-        companions (coalescing).  ``block_s == 0`` is the busy path —
-        return whatever is queued right now, the decode loop must not
-        stall.  Requests whose deadline already passed are completed
+        first arrival (a condition-variable wakeup — ``submit`` and
+        ``wake`` notify, so idle admission latency is the notify hop, not
+        a polling interval; the timeout stays as a liveness fallback),
+        then hold it up to ``max_batch_delay_ms`` for companions
+        (coalescing).  ``block_s == 0`` is the busy path — return
+        whatever is queued right now, the decode loop must not stall.
+        Requests whose deadline already passed are completed
         exceptionally here and never returned.
         """
         if max_n <= 0:
@@ -174,7 +187,16 @@ class RequestQueue:
         out: list[PendingResult] = []
         with self._cv:
             if not self._items and block_s > 0:
-                self._cv.wait(block_s)
+                # loop: condition waits wake spuriously and on unrelated
+                # notifies — re-check the predicate until the deadline;
+                # an explicit wake() (engine shutdown, slot freed) breaks
+                # out immediately instead of riding out the timeout
+                end = time.monotonic() + block_s
+                while not self._items and not self._woken:
+                    left = end - time.monotonic()
+                    if left <= 0 or not self._cv.wait(left):
+                        break
+            self._woken = False
             if self._items and block_s > 0 and len(self._items) < max_n \
                     and self.max_batch_delay_ms > 0:
                 end = time.monotonic() + self.max_batch_delay_ms / 1000.0
@@ -222,6 +244,16 @@ class RequestQueue:
                     METRICS.increment("serving.deadline_dropped")
                 return False
             return True
+
+    def wake(self) -> None:
+        """Kick any idle ``take`` out of its wait immediately — called on
+        engine shutdown (so the serve loop observes the stop flag without
+        riding out ``idle_wait_s``) and when a decode slot frees while
+        the loop is parked (so a queued request is admitted on the notify
+        hop instead of the next poll)."""
+        with self._cv:
+            self._woken = True
+            self._cv.notify_all()
 
     def depth(self) -> int:
         with self._cv:

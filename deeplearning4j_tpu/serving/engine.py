@@ -29,6 +29,23 @@ RNG parity contract: slot ``s`` runs the exact draw sequence of
 split once per generated token, sample from the second half — so a
 served continuation is token-identical to the offline sampler under the
 same seed (the tier-1 acceptance test).
+
+PR-9 memory/latency tier, all OFF by default (DESIGN.md §17):
+
+- ``paged=True``: the dense ``(S, max_len)`` KV rows become fixed-size
+  pages in a shared device pool, addressed through per-slot block
+  tables (host free-list + refcounts in :class:`~.paging.PagePool`).
+  Decode gathers each row's logical K/V into exactly the dense shape
+  before the dense attention ops run, so logits stay bitwise.
+- ``prefix_cache=True``: a content-addressed cache (chained hash of
+  full token pages → pinned pages) admits shared prompt prefixes by
+  block-table aliasing — the system-prompt prefill runs once.
+- ``speculative=True``: a small draft model proposes ``spec_k`` greedy
+  tokens; ONE windowed verify dispatch on the target scores all of
+  them, and every emitted token is drawn from TARGET logits with the
+  request's exact offline key stream — the draft only decides how MANY
+  tokens emit per dispatch, never which, so token parity is preserved
+  under greedy and temperature sampling alike.
 """
 
 from __future__ import annotations
@@ -44,14 +61,18 @@ import numpy as np
 from jax import lax
 
 from ..analysis.runtime import allow_transfers, hot_loop_guard
-from ..models.transformer import (decode_step, init_decode_cache,
-                                  reset_cache_slots)
+from ..models.transformer import (decode_step, decode_step_paged,
+                                  decode_window, decode_window_paged,
+                                  gather_paged_kv, init_decode_cache,
+                                  init_paged_cache, paged_flat_index,
+                                  reset_cache_pages, reset_cache_slots)
 from ..observability import METRICS, trace
 from ..parallel.checkpoint import CheckpointManager
 from ..parallel.compile_cache import setup_compile_cache
 from ..resilience.faults import FAULTS
-from .batcher import (Completion, GenerateRequest, PendingResult,
-                      RequestQueue, ScoreRequest)
+from .batcher import (Completion, GenerateRequest, PagePoolExhausted,
+                      PendingResult, RequestQueue, ScoreRequest)
+from .paging import PagePool
 
 #: unit-interval buckets for fill-ratio histograms (observe_time is the
 #: registry's generic histogram feed; these are ratios, not seconds)
@@ -72,6 +93,17 @@ class ServingConfig:
     int8_decode: bool = False       # serve int8 weight-quantized FFN/head
     #                                 (opt-in; adoption gated on token-level
     #                                 top-1 agreement with f32 decode)
+    # ---- PR-9 paged/prefix/speculative tier (all default to the dense
+    # ---- behavior above; every combination keeps exact token parity)
+    paged: bool = False             # page-pool KV instead of dense slot rows
+    page_size: int = 16             # tokens per KV page (any size >= 1 works)
+    num_pages: int | None = None    # pool capacity; None -> slots*ceil(T/ps)
+    prefix_cache: bool = False      # content-addressed prefix sharing (paged)
+    speculative: bool = False       # draft-proposes / target-verifies decode
+    spec_k: int = 3                 # draft tokens proposed per verify window
+    paged_attention_impl: str = "gather"  # "gather" (jnp, bitwise) or a
+    #                                 registry candidate name — only adopt a
+    #                                 kernel through the bench autopick gate
 
 
 @dataclasses.dataclass
@@ -96,12 +128,41 @@ class InferenceEngine:
 
     def __init__(self, model, params=None, checkpoint=None,
                  cfg: ServingConfig = ServingConfig(),
-                 compile_cache_dir: str | None = None):
+                 compile_cache_dir: str | None = None,
+                 draft_model=None, draft_params=None):
         # PR-2 warmup integration: with a persistent cache dir configured
         # (env or explicit), the warmup compiles below hit disk
         setup_compile_cache(compile_cache_dir)
         self.model = model
         self.cfg = cfg
+        if cfg.prefix_cache and not cfg.paged:
+            raise ValueError("prefix_cache requires paged=True (sharing is "
+                             "block-table aliasing)")
+        if cfg.speculative:
+            if draft_model is None or draft_params is None:
+                raise ValueError("speculative=True needs draft_model and "
+                                 "draft_params (see zoo.draft_lm)")
+            if (draft_model.cfg.vocab_size != model.cfg.vocab_size
+                    or draft_model.cfg.max_len != model.cfg.max_len):
+                raise ValueError("draft model must share the target's "
+                                 "vocab_size and max_len")
+            if cfg.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+        self._draft_model = draft_model if cfg.speculative else None
+        self._draft_params = draft_params if cfg.speculative else None
+        # paged sizing: pages_per_slot covers max_len; one EXTRA physical
+        # trash page (index num_pages) absorbs the masked writes of
+        # inactive rows, whose stale block-table entries must never point
+        # at reallocatable pages
+        self._page_size = cfg.page_size
+        self._pages_per_slot = -(-model.cfg.max_len // cfg.page_size)
+        self._num_pages = (cfg.num_pages if cfg.num_pages is not None
+                           else cfg.slots * self._pages_per_slot)
+        self._pool = (PagePool(self._num_pages, cfg.page_size)
+                      if cfg.paged else None)
+        mcfg = model.cfg
+        self._page_bytes = (cfg.page_size * mcfg.n_heads * mcfg.head_dim
+                            * 2 * mcfg.n_layers * jnp.dtype(mcfg.dtype).itemsize)
         self._queue = RequestQueue(cfg.max_queue, cfg.max_batch_delay_ms)
         self._ckpt: CheckpointManager | None = None
         self._loaded_step: int | None = None
@@ -131,10 +192,16 @@ class InferenceEngine:
         self._raw_params = params                # guarded-by: self._lock
         self._params = self._maybe_quantize(params)  # guarded-by: self._lock
         self._state = self._init_state()
-        self._step_fn = jax.jit(self._build_step(), donate_argnums=(1,))
+        # device-resident chaos flags, built OUTSIDE the hot loop — the
+        # decode segment must not upload scalars under hot_loop_guard
+        self._garble = (jnp.int32(0), jnp.int32(1))
+        self._step_fn = jax.jit(
+            self._build_step(),
+            donate_argnums=(2,) if cfg.speculative else (1,))
         self._step_compiled = False
         self._admit_fns: dict[int, Callable] = {}    # guarded-by: self._lock
         self._slots: dict[int, _Slot] = {}           # guarded-by: self._lock
+        self._slot_pages: dict[int, list[int]] = {}  # guarded-by: self._lock
         self._free: list[int] = list(range(cfg.slots))  # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -156,8 +223,7 @@ class InferenceEngine:
     def _init_state(self) -> dict:
         cfg = self.model.cfg
         S = self.cfg.slots
-        return {
-            "cache": init_decode_cache(cfg, S),
+        state = {
             "toks": jnp.zeros((S, cfg.max_len), jnp.int32),
             "pos": jnp.zeros((S,), jnp.int32),
             "limit": jnp.zeros((S,), jnp.int32),
@@ -165,9 +231,36 @@ class InferenceEngine:
             "keys": jax.random.split(jax.random.key(0), S),
             "active": jnp.zeros((S,), bool),
         }
+        if self.cfg.paged:
+            # +1 physical page: the trash page every inactive block-table
+            # row points at, so masked writes never land on real pages
+            state["pages"] = init_paged_cache(
+                cfg, self._num_pages + 1, self._page_size)
+            state["bt"] = jnp.full((S, self._pages_per_slot),
+                                   self._num_pages, jnp.int32)
+        else:
+            state["cache"] = init_decode_cache(cfg, S)
+        if self.cfg.speculative:
+            state["draft_cache"] = init_decode_cache(self._draft_model.cfg, S)
+        return state
+
+    def _paged_attn_fn(self):
+        """The paged-attention read the step uses: None selects the
+        bitwise jnp gather path; any other name resolves a registry
+        candidate — which only config written by the bench autopick gate
+        (TUNE evidence + tolerance + margin) should ever select."""
+        impl = self.cfg.paged_attention_impl
+        if impl == "gather":
+            return None
+        from ..ops.pallas import registry as kernel_registry
+        return kernel_registry.get("paged_attention", impl).fn
 
     def _build_step(self) -> Callable:
+        if self.cfg.speculative:
+            return self._build_spec_step()
         cfg = self.model.cfg
+        paged = self.cfg.paged
+        attn_fn = self._paged_attn_fn() if paged else None
 
         def step(params, state):
             """Advance every occupied slot one token.
@@ -181,7 +274,15 @@ class InferenceEngine:
             temp, active, limit = state["temp"], state["active"], state["limit"]
             row = jnp.arange(toks.shape[0])
             cur = toks[row, pos]
-            logits, cache = decode_step(params, state["cache"], cur, pos, cfg)
+            if paged:
+                logits, pages = decode_step_paged(
+                    params, state["pages"], state["bt"], cur, pos, cfg,
+                    attn_fn=attn_fn)
+                kv_update = {"pages": pages}
+            else:
+                logits, cache = decode_step(params, state["cache"], cur, pos,
+                                            cfg)
+                kv_update = {"cache": cache}
             # per-slot RNG, exactly Transformer.sample's kv stream: split
             # the slot key, carry the first half, draw from the second
             pair = jax.vmap(jax.random.split)(state["keys"])    # (S, 2) keys
@@ -199,8 +300,101 @@ class InferenceEngine:
             kd = jax.random.key_data(state["keys"])
             keys = jax.random.wrap_key_data(
                 jnp.where(can[:, None], jax.random.key_data(carry), kd))
-            new_state = dict(state, cache=cache, toks=toks, pos=new_pos,
-                             keys=keys)
+            new_state = dict(state, toks=toks, pos=new_pos, keys=keys,
+                             **kv_update)
+            return new_state, emitted
+
+        return step
+
+    def _build_spec_step(self) -> Callable:
+        """Speculative decode dispatch: draft proposes ``spec_k`` greedy
+        tokens, the target verifies the whole window at once, and up to
+        ``spec_k + 1`` tokens emit.
+
+        Parity argument (DESIGN.md §17): window logits ``L_0..L_k`` are
+        the target's own next-token distributions at positions
+        ``pos..pos+k`` (the windowed pass is bitwise W sequential steps).
+        Token ``i`` is drawn from ``L_i`` with the request's i-th key
+        split — the exact op the non-speculative step would run — and
+        emits only while every earlier draft proposal matched its draw,
+        i.e. while the sequence prefix equals what sequential decoding
+        would have produced.  Keys advance by exactly the number of
+        emitted tokens.  The draft therefore controls throughput
+        (``serving.spec_accept_len``), never content."""
+        cfg = self.model.cfg
+        dcfg = self._draft_model.cfg
+        paged = self.cfg.paged
+        k_spec = self.cfg.spec_k
+        W = k_spec + 1
+
+        def step(params, dparams, state, garble):
+            toks, pos = state["toks"], state["pos"]
+            temp, active, limit = state["temp"], state["active"], state["limit"]
+            S = toks.shape[0]
+            row = jnp.arange(S)
+            cur = toks[row, pos]
+            # -- draft proposal chain (greedy; near max_len the clamped
+            # draft-cache writes can degrade proposals — accept rate
+            # drops, parity is untouched since only target draws emit)
+            dcache = state["draft_cache"]
+            proposals = []
+            inp = cur
+            for i in range(k_spec):
+                d_logits, dcache = decode_step(dparams, dcache, inp, pos + i,
+                                               dcfg)
+                nxt = jnp.argmax(d_logits, axis=-1).astype(jnp.int32)
+                # chaos serving.draft: a garbled draft must only shrink
+                # accept length, never change emitted tokens
+                nxt = (nxt + garble) % cfg.vocab_size
+                proposals.append(nxt)
+                inp = nxt
+            d = jnp.stack(proposals, axis=1)                     # (S, k)
+            window = jnp.concatenate([cur[:, None], d], axis=1)  # (S, W)
+            # -- one windowed verify on the target
+            if paged:
+                logits, pages = decode_window_paged(
+                    params, state["pages"], state["bt"], window, pos, cfg)
+                kv_update = {"pages": pages}
+            else:
+                logits, cache = decode_window(params, state["cache"], window,
+                                              pos, cfg)
+                kv_update = {"cache": cache}
+            # -- the offline key stream: split i times, draw pick_i from
+            # L_i with sub_i; emitted count m selects carry_m below
+            safe_t = jnp.where(temp > 0, temp, 1.0)
+            key_stack = [jax.random.key_data(state["keys"])]     # carry_0
+            picks = []
+            kcur = state["keys"]
+            for i in range(W):
+                pair = jax.vmap(jax.random.split)(kcur)
+                kcur, sub = pair[:, 0], pair[:, 1]
+                drawn = jax.vmap(jax.random.categorical)(
+                    sub, logits[:, i] / safe_t[:, None])
+                pick = jnp.where(
+                    temp > 0, drawn.astype(jnp.int32),
+                    jnp.argmax(logits[:, i], axis=-1).astype(jnp.int32))
+                picks.append(pick)
+                key_stack.append(jax.random.key_data(kcur))
+            picks = jnp.stack(picks, axis=1)                     # (S, W)
+            off = jnp.arange(W, dtype=jnp.int32)[None, :]
+            can = (active[:, None] & (pos[:, None] + off < limit[:, None])
+                   & (pos[:, None] + off + 1 < cfg.max_len))     # (S, W)
+            match = jnp.concatenate(
+                [jnp.ones((S, 1), bool), d == picks[:, :k_spec]], axis=1)
+            emit = jnp.cumprod((can & match).astype(jnp.int32),
+                               axis=1).astype(bool)              # (S, W)
+            m = emit.sum(axis=1).astype(jnp.int32)               # (S,)
+            emitted = jnp.where(emit, picks, -1)
+            tpos = pos[:, None] + 1 + off                        # (S, W)
+            flat = jnp.where(emit, row[:, None] * cfg.max_len + tpos,
+                             S * cfg.max_len)
+            toks = toks.reshape(-1).at[flat.reshape(-1)].set(
+                picks.reshape(-1), mode="drop").reshape(S, cfg.max_len)
+            kstack = jnp.stack(key_stack, axis=0)                # (W+1, S, ..)
+            keys = jax.random.wrap_key_data(kstack[m, row])      # carry_m
+
+            new_state = dict(state, toks=toks, pos=pos + m, keys=keys,
+                             draft_cache=dcache, **kv_update)
             return new_state, emitted
 
         return step
@@ -221,31 +415,77 @@ class InferenceEngine:
         if cached is not None:
             return cached
         cfg = self.model.cfg
+        paged = self.cfg.paged
+        spec = self.cfg.speculative
+        dcfg = self._draft_model.cfg if spec else None
+        ps = self._page_size
+        n_slot_pages = self._pages_per_slot
 
-        def admit(params, state, prompt, p_len, slot, key, temp, max_new):
+        def admit(params, dparams, state, prompt, p_len, cached_len, slot,
+                  key, temp, max_new):
             """Prefill ``prompt[:p_len]`` on a batch-of-1 cache through
             the SAME ``decode_step`` the steady loop uses (numerics cannot
             diverge from ``Transformer.sample``'s kv path), then scatter
-            the row into cache-pool row ``slot``.  Iterations past
-            ``p_len - 1`` are masked no-ops: one executable per bucket."""
-            cache1 = init_decode_cache(cfg, 1)
+            the row into the slot pool (dense) or the slot's pages.
+            Masked iterations are no-ops: one executable per bucket.
+
+            Paged: the batch-of-1 cache starts as a GATHER of the slot's
+            block-table row, so positions ``< cached_len`` (aliased
+            prefix pages) are already populated and the loop skips them;
+            the scatter-back rewrites shared pages with bitwise-identical
+            values (prefill is position-wise deterministic).  Speculative:
+            the draft cache prefills alongside (always from 0 — the
+            prefix cache holds target pages only)."""
+            if paged:
+                bt_row = lax.dynamic_slice(
+                    state["bt"], (slot, jnp.int32(0)), (1, n_slot_pages))
+                cache1 = [{"k": gather_paged_kv(c["k"], bt_row, cfg.max_len),
+                           "v": gather_paged_kv(c["v"], bt_row, cfg.max_len)}
+                          for c in state["pages"]]
+            else:
+                cache1 = init_decode_cache(cfg, 1)
+            dcache1 = init_decode_cache(dcfg, 1) if spec else jnp.int32(0)
             last = jnp.maximum(p_len - 2, 0)
 
-            def body(i, c):
+            def body(i, carry):
+                c, dc = carry
                 ii = jnp.minimum(i, last)
-                _, c_new = decode_step(
-                    params, c, lax.dynamic_slice(prompt, (ii,), (1,)), ii, cfg)
-                use = i < p_len - 1
-                return jax.tree_util.tree_map(
+                tok_i = lax.dynamic_slice(prompt, (ii,), (1,))
+                _, c_new = decode_step(params, c, tok_i, ii, cfg)
+                use = (i >= cached_len) & (i < p_len - 1)
+                c = jax.tree_util.tree_map(
                     lambda a, b: jnp.where(use, a, b), c_new, c)
+                if spec:
+                    _, dc_new = decode_step(dparams, dc, tok_i, ii, dcfg)
+                    dc = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(i < p_len - 1, a, b),
+                        dc_new, dc)
+                return c, dc
 
-            cache1 = lax.fori_loop(0, bucket, body, cache1)
-            cache = [
-                {"k": lax.dynamic_update_slice_in_dim(c["k"], c1["k"], slot,
-                                                      axis=0),
-                 "v": lax.dynamic_update_slice_in_dim(c["v"], c1["v"], slot,
-                                                      axis=0)}
-                for c, c1 in zip(state["cache"], cache1)]
+            cache1, dcache1 = lax.fori_loop(0, bucket, body, (cache1, dcache1))
+            if paged:
+                t = jnp.arange(cfg.max_len, dtype=jnp.int32)[None, :]
+                flat = paged_flat_index(bt_row, t, ps)[0]        # (max_len,)
+                kv_update = {"pages": [
+                    {"k": c["k"].reshape((-1,) + c["k"].shape[2:])
+                          .at[flat].set(c1["k"][0]).reshape(c["k"].shape),
+                     "v": c["v"].reshape((-1,) + c["v"].shape[2:])
+                          .at[flat].set(c1["v"][0]).reshape(c["v"].shape)}
+                    for c, c1 in zip(state["pages"], cache1)]}
+            else:
+                kv_update = {"cache": [
+                    {"k": lax.dynamic_update_slice_in_dim(c["k"], c1["k"],
+                                                          slot, axis=0),
+                     "v": lax.dynamic_update_slice_in_dim(c["v"], c1["v"],
+                                                          slot, axis=0)}
+                    for c, c1 in zip(state["cache"], cache1)]}
+            if spec:
+                kv_update["draft_cache"] = [
+                    {"k": lax.dynamic_update_slice_in_dim(c["k"], c1["k"],
+                                                          slot, axis=0),
+                     "v": lax.dynamic_update_slice_in_dim(c["v"], c1["v"],
+                                                          slot, axis=0)}
+                    for c, c1 in zip(state["draft_cache"], dcache1)]
             toks = lax.dynamic_update_slice(
                 state["toks"], prompt[None, :], (slot, jnp.int32(0)))
 
@@ -258,7 +498,6 @@ class InferenceEngine:
                 jax.random.key_data(key)[None], (slot, jnp.int32(0)))
             return dict(
                 state,
-                cache=cache,
                 toks=toks,
                 # sample() prefills tokens 0..P-2; the first engine step
                 # then processes token P-1 and draws the first new token
@@ -267,9 +506,10 @@ class InferenceEngine:
                 temp=put1(state["temp"], temp),
                 active=put1(state["active"], True),
                 keys=jax.random.wrap_key_data(kd),
+                **kv_update,
             )
 
-        prefill = jax.jit(admit, donate_argnums=(1,))
+        prefill = jax.jit(admit, donate_argnums=(2,))
         with self._lock:
             self._admit_fns[bucket] = prefill
         METRICS.increment("serving.prefill.recompile")
@@ -322,14 +562,20 @@ class InferenceEngine:
 
     def stop(self) -> None:
         self._stop.set()
+        self._queue.wake()   # kick the serve loop out of its idle wait
         if self._thread is not None:
             self._thread.join(timeout=30.0)
             self._thread = None
         with self._lock:
             dead = [self._slots.pop(s) for s in list(self._slots)]
+            pages = [self._slot_pages.pop(s, [])
+                     for s in list(self._slot_pages)]
         for sl in dead:
             sl.pending._fail(
                 RuntimeError("engine stopped with request in flight"))
+        if self._pool is not None:
+            for pg in pages:
+                self._pool.decref(pg)
         for p in self._queue.drain():
             p._fail(RuntimeError("engine stopped before request was admitted"))
 
@@ -339,26 +585,80 @@ class InferenceEngine:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def _bucket_ladder(self) -> list[int]:
+        """Every prefill bucket traffic can ever hit: the power-of-two
+        ladder from ``min_prefill_bucket`` up to (and including) the
+        ``max_len`` cap bucket."""
+        out = []
+        b = self.cfg.min_prefill_bucket
+        while b < self.model.cfg.max_len:
+            out.append(b)
+            b <<= 1
+        out.append(self.model.cfg.max_len)
+        return sorted(set(out))
+
     def warmup(self) -> None:
-        """Compile the steady-state step and the smallest prefill bucket
-        before traffic (with the PR-2 persistent compile cache configured
-        these are disk hits on restart) — first-request latency pays
-        trace+lower cost at most once, at startup."""
+        """Compile the steady-state step and EVERY prefill bucket up to
+        ``max_len`` before traffic (with the PR-2 persistent compile
+        cache configured these are disk hits on restart) — first-request
+        TTFT never pays a compile stall, whatever the prompt length, and
+        ``serving.prefill.recompile`` stays at bucket-ladder count for
+        the engine's whole lifetime."""
         with allow_transfers(), METRICS.time("serving.warmup"):
-            state, _ = self._step_fn(self._params, self._state)
-            self._step_compiled = True
-            bucket = self._prompt_bucket(1)
-            fn = self._admit_for(bucket)
-            state = fn(self._params, state,
-                       jnp.zeros((bucket,), jnp.int32), jnp.int32(1),
-                       jnp.int32(0), jax.random.key(0), jnp.float32(0.0),
-                       jnp.int32(0))
-            # the warmup admit occupied slot 0 with a dummy — deactivate.
-            # graftlint: disable=LK01 — _state is serve-thread-owned (every
-            # other write site runs on the serve loop); warmup runs strictly
-            # before Thread.start(), which is a happens-before edge, so this
-            # external-context write can never race the loop
-            self._state = dict(state, active=jnp.zeros_like(state["active"]))
+            pages: list[int] = []
+            try:
+                if self.cfg.paged:
+                    # slot 0 needs a real block-table row for the dummy
+                    # admits below; released (and re-trashed) in finally
+                    pages = self._pool.alloc(self._pages_per_slot)
+                    # graftlint: disable=LK01 — _state is serve-thread-
+                    # owned; warmup (and every other flagged site) runs
+                    # either before Thread.start() or ON the serve loop,
+                    # so there is a happens-before edge, never a race
+                    self._state = dict(
+                        self._state,
+                        bt=self._state["bt"].at[0].set(
+                            jnp.asarray(pages, jnp.int32)))
+                dparams = self._draft_params if self.cfg.speculative else {}
+                if self.cfg.speculative:
+                    state, _ = self._step_fn(self._params, dparams,
+                                             self._state, jnp.int32(0))
+                else:
+                    state, _ = self._step_fn(self._params, self._state)
+                self._step_compiled = True
+                for bucket in self._bucket_ladder():
+                    fn = self._admit_for(bucket)
+                    state = fn(self._params, dparams, state,
+                               jnp.zeros((bucket,), jnp.int32), jnp.int32(1),
+                               jnp.int32(0), jnp.int32(0), jax.random.key(0),
+                               jnp.float32(0.0), jnp.int32(0))
+                # the warmup admits occupied slot 0 with a dummy —
+                # deactivate, and park its block-table row back on the
+                # trash page so the freed pages are writable by nobody.
+                # graftlint: disable=LK01 — _state is serve-thread-owned
+                # (every other write site runs on the serve loop); warmup
+                # runs strictly before Thread.start(), which is a
+                # happens-before edge, so this write can never race
+                self._state = dict(
+                    state, active=jnp.zeros_like(state["active"]))
+            finally:
+                if pages:
+                    freed = self._pool.decref(pages)
+                    self._wipe_pages(freed)
+                    self._state = dict(
+                        self._state,
+                        bt=self._state["bt"].at[0].set(self._num_pages))
+
+    def _wipe_pages(self, freed: list[int]) -> None:
+        """Zero physical pages whose refcount just hit zero (never an
+        aliased page — ``PagePool.decref`` only returns dead ones)."""
+        if not freed or not self.cfg.paged:
+            return
+        mask = np.zeros((self._num_pages + 1,), bool)
+        mask[freed] = True
+        self._state = dict(
+            self._state,
+            pages=reset_cache_pages(self._state["pages"], jnp.asarray(mask)))
 
     def _serve_loop(self) -> None:
         while not self._stop.is_set():
@@ -368,9 +668,12 @@ class InferenceEngine:
                 METRICS.increment("serving.engine.errors")
                 with self._lock:
                     dead = [self._slots.pop(s) for s in list(self._slots)]
+                    self._slot_pages.clear()
                     self._free = list(range(self.cfg.slots))
                 for sl in dead:
                     sl.pending._fail(e)
+                if self._pool is not None:
+                    self._pool.reset()
                 with allow_transfers():
                     self._state = self._init_state()
 
@@ -409,29 +712,105 @@ class InferenceEngine:
             with self._lock:
                 slot = self._free.pop()
                 params = self._params
+            acquired: list[int] = []
             try:
+                cached_len = 0
+                if self.cfg.paged:
+                    if FAULTS.check("serving.page_pool") is not None:
+                        raise PagePoolExhausted(
+                            "injected page-pool exhaustion (chaos site "
+                            "serving.page_pool)")
+                    usable = len(req.prompt) - 1
+                    if self.cfg.prefix_cache:
+                        shared, cached_len = self._pool.lookup_prefix(
+                            req.prompt, usable)
+                        acquired.extend(shared)
+                    # allocate for what THIS request can touch (prompt +
+                    # budget, the engine writes positions [0, limit]),
+                    # not max_len — the paged footprint win; the row's
+                    # unneeded tail parks on the trash page, which decode
+                    # may scribble on but never attends
+                    need = -(-(len(req.prompt) + req.max_new_tokens)
+                             // self._page_size)
+                    acquired.extend(self._pool.alloc(need - len(acquired)))
+                    row = acquired + [self._num_pages] * (
+                        self._pages_per_slot - len(acquired))
+                    self._state = dict(
+                        self._state,
+                        bt=self._state["bt"].at[slot].set(
+                            jnp.asarray(row, jnp.int32)))
                 bucket = self._prompt_bucket(len(req.prompt))
                 prompt = np.zeros((bucket,), np.int32)
                 prompt[:len(req.prompt)] = req.prompt
                 admit_fn = self._admit_for(bucket)
+                dparams = self._draft_params if self.cfg.speculative else {}
                 self._state = admit_fn(
-                    params, self._state, jnp.asarray(prompt),
-                    jnp.int32(len(req.prompt)), jnp.int32(slot),
-                    jax.random.key(req.seed), jnp.float32(req.temperature),
+                    params, dparams, self._state, jnp.asarray(prompt),
+                    jnp.int32(len(req.prompt)), jnp.int32(cached_len),
+                    jnp.int32(slot), jax.random.key(req.seed),
+                    jnp.float32(req.temperature),
                     jnp.int32(req.max_new_tokens))
+                if self.cfg.prefix_cache:
+                    # publish every full-page chain of this prompt —
+                    # entries pin their pages with their own refcount
+                    self._pool.insert_prefix(req.prompt, acquired, usable)
+                    if cached_len:
+                        METRICS.increment("serving.prefix_hits")
             except Exception as e:
-                # fail only THIS request — the slot goes back to the pool
-                # and the rest of the batch still admits
+                # fail only THIS request — the slot (and any pages it
+                # acquired) go back to the pool; the rest of the batch
+                # still admits.  PagePoolExhausted lands here too: 429
+                # backpressure, not an engine error
+                if acquired:
+                    self._wipe_pages(self._pool.decref(acquired))
+                if self.cfg.paged:
+                    # park the row on the trash page again — a stale
+                    # table must never alias reallocatable pages
+                    self._state = dict(
+                        self._state,
+                        bt=self._state["bt"].at[slot].set(self._num_pages))
                 with self._lock:
                     self._free.append(slot)
-                METRICS.increment("serving.engine.errors")
+                if isinstance(e, PagePoolExhausted):
+                    METRICS.increment("serving.page_pool_exhausted")
+                else:
+                    METRICS.increment("serving.engine.errors")
                 p._fail(e)
                 continue
             with self._lock:
                 self._slots[slot] = _Slot(pending=p,
                                           admitted_s=time.monotonic())
+                self._slot_pages[slot] = acquired
                 self._admitted += 1
             METRICS.increment("serving.admitted")
+            self._publish_kv_gauges()
+
+    def _publish_kv_gauges(self) -> None:
+        """Device-KV footprint gauges at admission/eviction fences: pages
+        in use (shared pages count ONCE — that is the point), bytes, and
+        bytes per occupied slot vs the dense ``S*max_len`` baseline."""
+        if self._pool is None:
+            mcfg = self.model.cfg
+            dense = (mcfg.max_len * mcfg.n_heads * mcfg.head_dim * 2
+                     * mcfg.n_layers * jnp.dtype(mcfg.dtype).itemsize)
+            METRICS.gauge("serving.kv_bytes", dense * self.cfg.slots)
+            METRICS.gauge("serving.kv_bytes_per_slot", dense)
+            return
+        in_use = self._pool.in_use()
+        with self._lock:
+            occupied = len(self._slots)
+            slot_pages: set[int] = set()
+            for pages in self._slot_pages.values():
+                slot_pages.update(pages)
+        METRICS.gauge("serving.kv_pages_in_use", in_use)
+        METRICS.gauge("serving.prefix_hit_rate", self._pool.hit_rate())
+        METRICS.gauge("serving.kv_bytes", in_use * self._page_bytes)
+        # per-slot cost counts pages *referenced by occupied slots* once
+        # (shared prefix pages amortize — that is the point); cache pins
+        # with no live reader are capacity (kv_bytes), not per-slot cost
+        METRICS.gauge("serving.kv_bytes_per_slot",
+                      len(slot_pages) * self._page_bytes / occupied
+                      if occupied else 0.0)
 
     def _decode_segment(self) -> list:
         """Dispatch ``resolve_every`` decode steps with NO host syncs —
@@ -440,6 +819,8 @@ class InferenceEngine:
         step_fn = self._step_fn
         with self._lock:
             params = self._params
+        spec = self.cfg.speculative
+        dparams = self._draft_params if spec else None
         for _ in range(self.cfg.resolve_every):
             if FAULTS.check("serving.decode") is not None:
                 # transient decode fault (chaos): this dispatch is skipped,
@@ -447,7 +828,19 @@ class InferenceEngine:
                 # stay token-identical under injection
                 METRICS.increment("serving.decode.faults")
                 continue
-            self._state, emitted = step_fn(params, self._state)
+            if spec:
+                # chaos serving.draft: garble every draft proposal this
+                # dispatch — the traced flag shifts the draft argmax, so
+                # accept length collapses but emitted tokens (drawn from
+                # target logits) are untouched
+                garbled = FAULTS.check("serving.draft") is not None
+                if garbled:
+                    METRICS.increment("serving.draft.faults")
+                self._state, emitted = step_fn(
+                    params, dparams, self._state,
+                    self._garble[1 if garbled else 0])
+            else:
+                self._state, emitted = step_fn(params, self._state)
             out.append(emitted)
         METRICS.increment("serving.decode.dispatches", len(out))
         return out
@@ -457,17 +850,28 @@ class InferenceEngine:
         emitted tokens, then EOS/length bookkeeping and metrics."""
         if not pending:
             return
-        em = np.asarray(jax.device_get(jnp.stack(pending)))     # (k, S)
+        em = np.asarray(jax.device_get(jnp.stack(pending)))  # (k, S[, W])
+        if em.ndim == 2:
+            em = em[:, :, None]   # non-speculative: window of one
         now = time.monotonic()
         seg_s = time.perf_counter() - t0
         n_steps = len(pending)
         METRICS.observe_many("serving.decode_step", [seg_s / n_steps] * n_steps)
+        if self.cfg.speculative:
+            # accepted-prefix length per dispatch per live slot (clipped
+            # emissions at the limit count too — still useful signal)
+            counts = (em >= 0).sum(axis=2)
+            METRICS.observe_many(
+                "serving.spec_accept_len",
+                [float(c) for c in counts[counts > 0]],
+                buckets=tuple(float(i)
+                              for i in range(1, self.cfg.spec_k + 2)))
         delivered = 0
         for s in list(self._slots):
             sl = self._slots[s]
             req: GenerateRequest = sl.pending.request
             finish = None
-            for t in em[:, s]:
+            for t in em[:, s].reshape(-1):
                 t = int(t)
                 if t < 0:
                     continue
@@ -492,20 +896,33 @@ class InferenceEngine:
 
     def _evict(self, s: int, finish: str, now: float) -> None:
         """Free slot ``s``: complete the caller, drop the host record,
-        deactivate the row and wipe its K/V (tokens the segment over-
-        decoded past EOS died here, discarded at the fence)."""
+        deactivate the row and release its K/V.  Dense: wipe the cache
+        row.  Paged: decref the slot's pages — only pages whose refcount
+        hits zero are wiped (an aliased prefix page stays live and
+        intact for its other readers), and the block-table row parks on
+        the trash page."""
         with self._lock:
             sl = self._slots.pop(s)
+            pages = self._slot_pages.pop(s, [])
             self._free.append(s)
             self._completed += 1
-        mask = np.zeros((self.cfg.slots,), bool)
-        mask[s] = True
-        # the freed row is reusable before this wipe lands only by
+        # the freed row is reusable before these updates land only by
         # _admit, which runs on this same serve thread — no interleave
-        self._state = dict(
-            self._state,
-            cache=reset_cache_slots(self._state["cache"], jnp.asarray(mask)),
-            active=self._state["active"].at[s].set(False))
+        if self.cfg.paged:
+            self._state = dict(
+                self._state,
+                bt=self._state["bt"].at[s].set(self._num_pages),
+                active=self._state["active"].at[s].set(False))
+            self._wipe_pages(self._pool.decref(pages))
+            self._publish_kv_gauges()
+        else:
+            mask = np.zeros((self.cfg.slots,), bool)
+            mask[s] = True
+            self._state = dict(
+                self._state,
+                cache=reset_cache_slots(self._state["cache"],
+                                        jnp.asarray(mask)),
+                active=self._state["active"].at[s].set(False))
         req = sl.pending.request
         METRICS.increment("serving.completed")
         METRICS.observe_time("serving.request_latency", now - req.submitted_s)
@@ -544,7 +961,7 @@ class InferenceEngine:
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "slots": self.cfg.slots,
                 "active": len(self._slots),
                 "free": len(self._free),
@@ -555,6 +972,12 @@ class InferenceEngine:
                 "prefill_buckets": sorted(self._admit_fns),
                 "running": self._thread is not None,
             }
+        if self._pool is not None:
+            out["kv_pages"] = self._num_pages
+            out["kv_pages_in_use"] = self._pool.in_use()
+            out["prefix_entries"] = self._pool.prefix_entries()
+            out["prefix_hit_rate"] = self._pool.hit_rate()
+        return out
 
 
 class BatchScorer:

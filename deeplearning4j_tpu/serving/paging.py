@@ -1,0 +1,200 @@
+"""Host-side bookkeeping for the paged KV cache (DESIGN.md §17).
+
+The device side is dumb on purpose: per-layer ``(num_pages, page_size,
+H, Dh)`` pools plus an ``(S, pages_per_slot)`` int32 block table, both
+living in the engine's donated decode state.  Everything stateful —
+free-list, per-page refcounts, the content-addressed prefix cache —
+lives HERE, on the host, under one lock, so the decode hot loop never
+synchronizes on allocation metadata.
+
+Prefix cache: content addressing is a chained hash over FULL token
+pages — ``h_k = H(h_{k-1} || tokens[(k-1)*ps : k*ps])`` — so a lookup
+walks the chain until the first miss and aliases the longest cached
+run.  Only positions the prefill actually computes are shareable: a
+prompt of length ``p`` prefills K/V for positions ``[0, p-1)`` (the
+last token is the first decode query), so a chain of ``k`` pages is
+usable only when ``k * page_size <= p - 1``.  Cache entries PIN their
+pages with a refcount; slots aliasing them add one more ref each.  A
+page is freed (and wiped by the engine) only when its count reaches
+zero, so an aliased page can never be reused or zeroed under a reader.
+
+The pool never touches device arrays: acquire/release return page ids
+and the ENGINE gathers/scatters/wipes at its fences — keeping this
+module trivially testable and the lock discipline one-directional
+(pool lock is a leaf: nothing is called while holding it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from .batcher import PagePoolExhausted
+
+
+class PrefixEntry:
+    """One cached chain: the first ``len(pages)`` full token pages of
+    some prompt, pinned (one refcount per page) until LRU-evicted."""
+
+    __slots__ = ("pages", "tick")
+
+    def __init__(self, pages: tuple[int, ...], tick: int):
+        self.pages = pages
+        self.tick = tick
+
+
+class PagePool:
+    """Free-list + refcounts + prefix cache over ``num_pages`` usable
+    pages (the engine typically appends one extra physical trash page
+    OUTSIDE this pool for inactive-slot writes)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._lock = threading.Lock()
+        self._free = list(range(num_pages))          # guarded-by: self._lock
+        self._ref = [0] * num_pages                  # guarded-by: self._lock
+        self._prefix: dict[str, PrefixEntry] = {}    # guarded-by: self._lock
+        self._tick = 0                               # guarded-by: self._lock
+        self._lookups = 0                            # guarded-by: self._lock
+        self._hits = 0                               # guarded-by: self._lock
+
+    # -- content addressing ---------------------------------------------
+    def chain_keys(self, tokens: list[int], usable: int) -> list[str]:
+        """Chained hashes for every full token page covering positions
+        ``< usable`` — key ``i`` addresses K/V for ``tokens[: (i+1)*ps]``
+        and, being chained, commits to the entire prefix, not just its
+        own page."""
+        ps = self.page_size
+        keys: list[str] = []
+        h = b"kv-prefix-v1"
+        for k in range(1, usable // ps + 1):
+            block = tokens[(k - 1) * ps: k * ps]
+            h = hashlib.blake2b(
+                h + (",".join(map(str, block))).encode(), digest_size=16,
+            ).digest()
+            keys.append(h.hex())
+        return keys
+
+    # -- acquire side ---------------------------------------------------
+    def lookup_prefix(self, tokens: list[int], usable: int):
+        """Longest cached chain of full token pages covering at most
+        ``usable`` positions.  Every matched page is increffed FOR THE
+        CALLER (the slot's alias) before return, so a concurrent LRU
+        eviction can free the entry but never the pages under the new
+        reader.  Returns ``(pages, cached_positions)``."""
+        keys = self.chain_keys(tokens, usable)
+        with self._lock:
+            self._lookups += 1
+            best: PrefixEntry | None = None
+            for key in keys:
+                entry = self._prefix.get(key)
+                if entry is None:
+                    break
+                best = entry
+            if best is None:
+                return [], 0
+            self._hits += 1
+            self._tick += 1
+            best.tick = self._tick
+            for p in best.pages:
+                self._ref[p] += 1
+            return list(best.pages), len(best.pages) * self.page_size
+
+    def alloc(self, n: int) -> list[int]:
+        """Take ``n`` fresh pages (refcount 1 each), LRU-evicting unpinned
+        prefix entries as needed; raises :class:`PagePoolExhausted` when
+        even a drained cache cannot cover the request."""
+        with self._lock:
+            while len(self._free) < n and self._evict_lru_locked():
+                pass
+            if len(self._free) < n:
+                raise PagePoolExhausted(
+                    f"KV page pool exhausted: need {n} pages, "
+                    f"{len(self._free)}/{self.num_pages} free and no "
+                    "evictable prefix entries — retry when slots drain")
+            out = [self._free.pop() for _ in range(n)]
+            for p in out:
+                self._ref[p] = 1
+            return out
+
+    def insert_prefix(self, tokens: list[int], pages: list[int],
+                      usable: int) -> None:
+        """Publish every full-page chain of ``tokens[:usable]`` backed by
+        the slot's ``pages`` (block-table order).  Each new entry pins
+        its pages with one more refcount; chains already present are
+        left alone (their pages already hold bitwise-identical K/V —
+        prefill is position-wise deterministic)."""
+        keys = self.chain_keys(tokens, usable)
+        with self._lock:
+            for i, key in enumerate(keys):
+                if key in self._prefix:
+                    self._tick += 1
+                    self._prefix[key].tick = self._tick
+                    continue
+                chain = tuple(pages[: i + 1])
+                for p in chain:
+                    self._ref[p] += 1
+                self._tick += 1
+                self._prefix[key] = PrefixEntry(chain, self._tick)
+
+    # -- release side ---------------------------------------------------
+    def decref(self, pages: list[int]) -> list[int]:
+        """Drop one reference per page; returns the pages whose count
+        reached zero (now back on the free list) so the caller can wipe
+        them on device.  Aliased pages (count still > 0) are NOT
+        returned — they must be neither wiped nor reused."""
+        with self._lock:
+            return self._decref_locked(pages)
+
+    def _decref_locked(self, pages) -> list[int]:
+        freed: list[int] = []
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise AssertionError(f"page {p} refcount underflow")
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def _evict_lru_locked(self) -> bool:
+        """Drop the least-recently-touched prefix entry (its pin only —
+        slots still aliasing the pages keep them alive)."""
+        if not self._prefix:
+            return False
+        key = min(self._prefix, key=lambda k: self._prefix[k].tick)
+        entry = self._prefix.pop(key)
+        self._decref_locked(list(entry.pages))
+        return True
+
+    def reset(self) -> None:
+        """Forget everything (serve-loop crash recovery: the engine
+        reinitializes device state, so host bookkeeping starts over)."""
+        with self._lock:
+            self._free = list(range(self.num_pages))
+            self._ref = [0] * self.num_pages
+            self._prefix.clear()
+
+    # -- introspection --------------------------------------------------
+    def in_use(self) -> int:
+        with self._lock:
+            return self.num_pages - len(self._free)
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._ref[page]
+
+    def prefix_entries(self) -> int:
+        with self._lock:
+            return len(self._prefix)
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            return self._hits / self._lookups if self._lookups else 0.0

@@ -463,3 +463,61 @@ def test_baseline_roundtrip_for_concurrency_findings(tmp_path):
     refound = lint(LK02_BAD, only="LK02", baseline=loaded)
     assert [f.status for f in refound if f.rule == "LK02"] == [BASELINED]
     assert active(refound) == []
+
+
+# ------------------------------------------------------------------- PG01
+
+PG01_BAD = """
+    def admit(pool, n):
+        pages = pool.alloc(n)          # acquire with no release on unwind
+        prefill(pages)                 # can raise -> pages leak pinned
+        return pages
+"""
+
+PG01_GOOD = """
+    def admit(pool, n):
+        try:
+            pages = pool.alloc(n)
+            prefill(pages)
+        except Exception:
+            pool.decref(pages)
+            raise
+        return pages
+"""
+
+PG01_GOOD_FINALLY = """
+    def warmup(page_pool):
+        try:
+            pages = page_pool.lookup_prefix([1, 2], 2)
+            compile_buckets(pages)
+        finally:
+            page_pool.decref(pages)
+"""
+
+
+def test_pg01_fires_on_bare_acquire_in_serving():
+    findings = active(lint(PG01_BAD, only="PG01",
+                           path="deeplearning4j_tpu/serving/fixture.py"))
+    assert len(findings) == 1
+    assert "pool.alloc" in findings[0].message
+    assert "decref" in findings[0].message
+
+
+def test_pg01_quiet_with_release_on_exit_paths():
+    for src in (PG01_GOOD, PG01_GOOD_FINALLY):
+        assert active(lint(src, only="PG01",
+                           path="deeplearning4j_tpu/serving/fixture.py")) == []
+
+
+def test_pg01_scoped_to_serving_and_exempts_pool_internals():
+    # the same bare acquire outside serving/ is out of scope
+    assert active(lint(PG01_BAD, only="PG01",
+                       path="deeplearning4j_tpu/parallel/fixture.py")) == []
+    # the pool's own internals (self.<acquire>) own their invariants
+    internals = """
+        class PagePool:
+            def lookup_prefix(self, tokens, usable):
+                return self.alloc(2)
+    """
+    assert active(lint(internals, only="PG01",
+                       path="deeplearning4j_tpu/serving/paging.py")) == []

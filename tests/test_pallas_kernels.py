@@ -44,7 +44,8 @@ def _close(a, b, dtype):
 def test_registry_kinds_and_candidates_complete():
     assert registry.import_errors() == {}
     assert registry.kinds() == ["attention", "int8_matmul",
-                                "layernorm_residual", "xent"]
+                                "layernorm_residual", "paged_attention",
+                                "xent"]
     assert [c.name for c in registry.candidates("attention")] == [
         "flash", "fused", "ring"]
     # every pallas candidate ships a reference and documented tolerances
@@ -383,3 +384,70 @@ def test_near_prime_token_count_streams_through_blocked_xent():
     chunked = lm_head_loss(params, h, tgts, cfg)
     full = lm_head_loss(params, h, tgts, _tiny_cfg(xent_chunk=0))
     np.testing.assert_allclose(float(chunked), float(full), rtol=1e-5)
+
+
+# ----------------------------------------------------------- paged attention
+
+def _paged_case(dtype, B=3, H=4, D=16, ps=5, n_pages=4, seed=0):
+    from deeplearning4j_tpu.ops.pallas.paged_attention import (
+        paged_attention, reference_paged_attention)
+
+    rng = np.random.default_rng(seed)
+    n_phys = B * n_pages + 1
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((n_phys, ps, H, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((n_phys, ps, H, D)), dtype)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[: B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    lengths = jnp.asarray([1, ps + 2, n_pages * ps], jnp.int32)[:B]
+    return paged_attention, reference_paged_attention, (q, k, v, bt, lengths)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_paged_attention_parity_odd_page_size(dtype):
+    """Interpret-mode kernel vs the jnp gather reference at an odd page
+    size, including a row whose valid length is 1 (one real K/V entry,
+    three fully-masked pages — the running-softmax edge case) and a row
+    ending exactly on a page boundary."""
+    fn, ref, args = _paged_case(dtype)
+    out = fn(*args)
+    want = ref(*args)
+    assert out.dtype == args[0].dtype
+    _close(out, want, dtype)
+
+
+def test_paged_attention_reads_through_block_table():
+    """Permuting the physical pages while permuting the table the same
+    way must not change the result — the kernel really addresses K/V
+    through the scalar-prefetched table, not by position."""
+    fn, ref, (q, k, v, bt, lengths) = _paged_case(jnp.float32, seed=3)
+    base = fn(q, k, v, bt, lengths)
+    perm = np.random.default_rng(7).permutation(k.shape[0])
+    inv = np.argsort(perm)
+    k2 = k[perm]
+    v2 = v[perm]
+    bt2 = jnp.asarray(np.asarray(inv)[np.asarray(bt)], jnp.int32)
+    again = fn(q, k2, v2, bt2, lengths)
+    _close(again, base, jnp.float32)
+
+
+def test_paged_attention_registered_behind_autopick_gate():
+    """The serving engine may only reach the Pallas candidate through
+    the registry, and the registry's gate must refuse it without fresh
+    correctness + margin evidence."""
+    cand = registry.get("paged_attention", "pallas")
+    inc = registry.get("paged_attention", "gather")
+    assert inc.source == "xla" and cand.tolerances["max_err"] == 0.05
+    rows = [
+        {"kernel": "paged_attention", "candidate": "gather",
+         "tokens_per_sec": 100.0},
+        {"kernel": "paged_attention", "candidate": "pallas",
+         "check": {"max_err": 0.001}},
+        {"kernel": "paged_attention", "candidate": "pallas",
+         "tokens_per_sec": 101.0},
+    ]
+    pick = registry.autopick("paged_attention", rows, incumbent="gather")
+    assert pick.choice == "gather"       # within 2%: no adoption
+    rows[-1]["tokens_per_sec"] = 150.0
+    pick = registry.autopick("paged_attention", rows, incumbent="gather")
+    assert pick.choice == "pallas"       # evidence + margin: adopted

@@ -1,0 +1,354 @@
+"""Paged-KV / prefix-sharing / speculative-decoding tests (DESIGN.md §17).
+
+The contract under test is the PR-9 tentpole's acceptance bar: with
+``paged=True`` (any page size, including sizes that do NOT divide
+``max_len``), with ``prefix_cache=True``, with ``speculative=True``, and
+with all three together, the engine's served tokens stay BITWISE
+identical to ``Transformer.sample(..., kv_cache=True)`` — paging,
+aliasing and draft-verify are memory/throughput techniques, never a
+semantics change.  Alongside parity: page refcount hygiene (nothing
+leaks, nothing aliased is ever wiped or reused), pool-exhaustion
+backpressure (429, not a crash), and the chaos site for it.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import zoo
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM, decode_step,
+                                                   decode_step_paged,
+                                                   decode_window,
+                                                   init_decode_cache,
+                                                   init_paged_cache)
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+from deeplearning4j_tpu.serving import (InferenceEngine, PagePool,
+                                        PagePoolExhausted, ServingConfig)
+
+
+def tiny_cfg(**kw):
+    kw.setdefault("vocab_size", 64)
+    kw.setdefault("d_model", 32)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("d_ff", 64)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("dtype", jnp.float32)  # exact parity comparisons
+    kw.setdefault("remat", False)
+    return TransformerConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = tiny_cfg()
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.key(7))
+
+
+PLANS = [([5, 1, 4], 6, 0.0, 0),
+         ([2, 8, 2, 8, 2, 8, 2, 8, 2], 4, 0.8, 123),
+         ([7], 5, 0.0, 3),
+         ([3, 2, 1, 0, 5], 6, 1.0, 9)]
+
+
+def _expected(model, params, prompt, n, temp, seed):
+    return model.sample(params, prompt, n, temperature=temp,
+                        key=jax.random.key(seed),
+                        kv_cache=True)[len(prompt):]
+
+
+def _serve_plans(model, params, scfg, plans=PLANS, **engine_kw):
+    """Run ``plans`` through a fresh engine; returns the token lists."""
+    engine = InferenceEngine(model, params=params, cfg=scfg, **engine_kw)
+    handles = [engine.submit(p, n, temperature=t, seed=s)
+               for p, n, t, s in plans]
+    with engine:
+        return engine, [h.result(120.0).tokens for h in handles]
+
+
+# ------------------------------------------------------------------ paging
+@pytest.mark.parametrize("page_size", [3, 5])
+def test_paged_parity_at_odd_page_sizes(lm, page_size):
+    """Bitwise token parity with page sizes that do not divide max_len —
+    the partial last page and mid-page position math get no slack."""
+    model, params = lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    _, got = _serve_plans(model, params,
+                          ServingConfig(slots=3, resolve_every=2, paged=True,
+                                        page_size=page_size))
+    assert got == want
+
+
+def test_paged_pool_drains_after_traffic(lm):
+    """Every page acquired for a request is back on the free list after
+    the request completes — the no-leak invariant PG01 lints for."""
+    model, params = lm
+    engine, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4))
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    assert got == want
+    assert engine._pool.free_count() == engine._pool.num_pages
+    stats = engine.stats()
+    assert stats["kv_pages_in_use"] == 0
+    assert stats["kv_pages"] == engine._pool.num_pages
+
+
+def test_decode_window_bitwise_vs_sequential_steps(lm):
+    """The speculative verify primitive: one (B, W) window dispatch must
+    leave logits AND cache bytes identical to W sequential decode_step
+    calls — including at the max_len boundary, where out-of-range window
+    positions must be dropped, not clamped onto the last live row."""
+    model, params = lm
+    cfg = model.cfg
+    B, W = 2, 4
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, W)), jnp.int32)
+    for start in (6, cfg.max_len - 2):        # mid-stream and boundary
+        pos = jnp.full((B,), start, jnp.int32)
+        cache_a = init_decode_cache(cfg, B)
+        cache_b = init_decode_cache(cfg, B)
+        # warm both caches identically so attention sees a real prefix
+        for i in range(start):
+            tok = jnp.full((B,), (i * 7) % cfg.vocab_size, jnp.int32)
+            la, cache_a = decode_step(params, cache_a, tok,
+                                      jnp.full((B,), i, jnp.int32), cfg)
+            _, cache_b = decode_step(params, cache_b, tok,
+                                     jnp.full((B,), i, jnp.int32), cfg)
+        win_logits, cache_a = decode_window(params, cache_a, toks, pos, cfg)
+        seq_logits = []
+        for w in range(W):
+            p = pos + w
+            ok = p < cfg.max_len
+            lw, cache_new = decode_step(
+                params, cache_b, toks[:, w], jnp.minimum(p, cfg.max_len - 1),
+                cfg)
+            # emulate the window path's OOB-drop: rows past max_len keep
+            # their cache untouched
+            cache_b = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(
+                    ok.reshape((B,) + (1,) * (a.ndim - 1)), a, b),
+                cache_new, cache_b)
+            seq_logits.append(lw)
+        for w in range(W):
+            valid = np.asarray(pos + w < cfg.max_len)
+            np.testing.assert_array_equal(
+                np.asarray(win_logits[:, w][valid]),
+                np.asarray(seq_logits[w][valid]))
+        for ca, cb in zip(cache_a, cache_b):
+            np.testing.assert_array_equal(np.asarray(ca["k"]),
+                                          np.asarray(cb["k"]))
+            np.testing.assert_array_equal(np.asarray(ca["v"]),
+                                          np.asarray(cb["v"]))
+
+
+def test_decode_step_paged_matches_dense(lm):
+    """Unit check under the engine: the paged single-position step is
+    bitwise the dense step at an odd page size."""
+    model, params = lm
+    cfg = model.cfg
+    B, ps = 3, 5
+    n_pages = -(-cfg.max_len // ps)
+    n_phys = B * n_pages + 1
+    rng = np.random.default_rng(1)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[:B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    dense = init_decode_cache(cfg, B)
+    pages = init_paged_cache(cfg, n_phys, ps)
+    for i in range(10):
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B,)), jnp.int32)
+        pos = jnp.full((B,), i, jnp.int32)
+        ld, dense = decode_step(params, dense, tok, pos, cfg)
+        lp, pages = decode_step_paged(params, pages, bt, tok, pos, cfg)
+        np.testing.assert_array_equal(np.asarray(ld), np.asarray(lp))
+
+
+# ------------------------------------------------------------ prefix cache
+@pytest.mark.lockguard
+def test_prefix_refcounts_never_free_aliased_pages():
+    """PagePool hygiene, run with instrumented locks: an aliased page is
+    freed (and thus wipeable/reusable) only when its LAST reader lets
+    go — cache eviction drops the pin, never the page."""
+    pool = PagePool(num_pages=8, page_size=2)
+    prompt = [1, 2, 3, 4, 5]             # 2 full pages usable (len-1 == 4)
+    a = pool.alloc(3)                    # slot A's pages
+    pool.insert_prefix(prompt, a, usable=4)   # pins a[0], a[1]
+    assert pool.prefix_entries() == 2    # chains of length 1 and 2
+    # slot B aliases the cached chain
+    shared, cached = pool.lookup_prefix(prompt, usable=4)
+    assert shared == a[:2] and cached == 4
+    assert pool.refcount(a[0]) == 4      # A + both chain pins + B
+    # slot A finishes: nothing it shares may be freed
+    assert pool.decref(a) == [a[2]]      # only the unshared tail page
+    grabbed = pool.alloc(6)              # exactly the free pages — no evict
+    assert pool.prefix_entries() == 2
+    assert not set(shared) & set(grabbed), "aliased page handed out twice"
+    # allocation pressure evicts both chains (pins drop) but B's pages
+    # survive the eviction, so the request STILL cannot be satisfied
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc(1)
+    assert pool.prefix_entries() == 0
+    assert pool.refcount(a[0]) == 1      # B still reading, page intact
+    # last reader lets go -> NOW the pages free
+    assert sorted(pool.decref(shared)) == sorted(shared)
+    pool.decref(grabbed)
+    assert pool.free_count() == pool.num_pages
+
+
+def test_prefix_exhaustion_evicts_lru_then_429s():
+    pool = PagePool(num_pages=4, page_size=2)
+    pages = pool.alloc(2)
+    pool.insert_prefix([1, 2, 3, 4, 5], pages, usable=4)
+    pool.decref(pages)                   # only the cache pins remain
+    assert pool.free_count() == 2
+    pool.alloc(4)                        # evicts the cache to make room
+    with pytest.raises(PagePoolExhausted) as ei:
+        pool.alloc(1)
+    assert ei.value.status == 429
+
+
+@pytest.mark.lockguard
+def test_prefix_sharing_engine_parity_and_hit_rate(lm):
+    """Shared system prompt across requests: bitwise parity AND a
+    positive prefix hit rate, with no page leaked after the drain."""
+    model, params = lm
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1, 0, 11, 12]   # 3 pages at ps=4
+    plans = [(sys_prompt + [t], 5, temp, seed)
+             for t, temp, seed in ((1, 0.0, 5), (2, 0.9, 17), (3, 0.0, 23),
+                                   (4, 0.7, 41))]
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in plans]
+    engine, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                      prefix_cache=True),
+        plans=plans)
+    assert got == want
+    stats = engine.stats()
+    assert stats["prefix_hit_rate"] > 0.0
+    assert stats["prefix_entries"] > 0
+    # drained: every non-free page is held by a cache pin, none by slots
+    pinned = engine._pool.in_use()
+    assert engine._pool.free_count() == engine._pool.num_pages - pinned
+    assert METRICS.snapshot()["counters"].get("serving.prefix_hits", 0) > 0
+
+
+# ------------------------------------------------------------- speculative
+def test_speculative_parity_good_and_bad_draft(lm):
+    """Token parity must not depend on draft quality: a self-draft
+    (agrees always — max accept length) and a garbage draft (random
+    init — near-zero accept) serve identical tokens; only
+    ``serving.spec_accept_len`` may differ."""
+    model, params = lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    draft, dparams = zoo.draft_lm(model.cfg, seed=99)
+    for name, dm, dp in (("self", model, params), ("garbage", draft, dparams)):
+        _, got = _serve_plans(
+            model, params,
+            ServingConfig(slots=3, resolve_every=2, speculative=True,
+                          spec_k=3),
+            draft_model=dm, draft_params=dp)
+        assert got == want, f"{name} draft broke parity"
+        hist = METRICS.snapshot()["timers"].get("serving.spec_accept_len")
+        assert hist is not None and hist["count"] > 0
+        METRICS.reset()
+
+
+def test_speculative_draft_divergence_chaos(lm):
+    """Chaos site ``serving.draft``: garbling the draft's proposals for a
+    window degrades accept length only — the served tokens still match
+    the offline sampler bitwise."""
+    model, params = lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    with inject_faults(FaultSpec("serving.draft", probability=1.0,
+                                 max_fires=4), seed=3):
+        _, got = _serve_plans(
+            model, params,
+            ServingConfig(slots=3, resolve_every=2, speculative=True,
+                          spec_k=2),
+            draft_model=model, draft_params=params)
+    assert got == want
+    assert METRICS.snapshot()["counters"].get("serving.draft.faults", 0) > 0
+
+
+def test_combined_paged_prefix_speculative_parity(lm):
+    model, params = lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    draft, dparams = zoo.draft_lm(model.cfg, seed=1)
+    _, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=3, resolve_every=2, paged=True, page_size=5,
+                      prefix_cache=True, speculative=True, spec_k=2),
+        draft_model=draft, draft_params=dparams)
+    assert got == want
+
+
+# ------------------------------------------------------------ backpressure
+def test_page_pool_exhaustion_rejects_with_429_and_recovers(lm):
+    """A pool too small for two concurrent sequences 429s the second
+    request (admission backpressure, slot goes back, nothing leaks) and
+    serves it fine once submitted after the first drains."""
+    model, params = lm
+    scfg = ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                         num_pages=9)          # warmup needs 8; 2 reqs don't fit
+    prompt, n_new = [1] * 20, 8                # need 7 pages each
+    want = [int(t) for t in _expected(model, params, prompt, n_new, 0.0, 7)]
+    engine = InferenceEngine(model, params=params, cfg=scfg)
+    h1 = engine.submit(prompt, n_new, seed=7)
+    h2 = engine.submit(prompt, n_new, seed=7)
+    with engine:
+        ok = h1.result(120.0)
+        with pytest.raises(PagePoolExhausted) as ei:
+            h2.result(120.0)
+        assert ei.value.status == 429
+        assert ok.tokens == want
+        # rejected admission leaked nothing; a later submit succeeds
+        assert engine._pool.free_count() == engine._pool.num_pages
+        assert engine.generate(prompt, n_new, seed=7).tokens == want
+    counters = METRICS.snapshot()["counters"]
+    assert counters["serving.page_pool_exhausted"] == 1
+    assert counters.get("serving.engine.errors", 0) == 0
+
+
+def test_page_pool_chaos_site_fixed_seed(lm):
+    """Fixed-seed chaos plan for ``serving.page_pool``: the injected
+    exhaustion 429s exactly one admission, leaks nothing, and later
+    requests serve token-identically."""
+    model, params = lm
+    want = [int(t) for t in _expected(model, params, [4, 5, 6], 5, 0.0, 13)]
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4))
+    with inject_faults(FaultSpec("serving.page_pool", probability=1.0,
+                                 max_fires=1), seed=42):
+        h1 = engine.submit([4, 5, 6], 5, seed=13)
+        with engine:
+            with pytest.raises(PagePoolExhausted):
+                h1.result(120.0)
+            assert engine.generate([4, 5, 6], 5, seed=13).tokens == want
+            assert engine._pool.free_count() == engine._pool.num_pages
+    assert METRICS.snapshot()["counters"]["serving.page_pool_exhausted"] == 1
+
+
+# ------------------------------------------------------------------ wakeup
+def test_cv_wakeup_beats_idle_poll(lm):
+    """The batcher's condition-variable wakeup: with a pathological
+    ``idle_wait_s`` the engine still admits (submit notifies) and stops
+    (wake breaks the wait) in far less than the poll period."""
+    model, params = lm
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=1, resolve_every=2, idle_wait_s=30.0))
+    with engine:
+        t0 = time.monotonic()
+        got = engine.generate([3, 1, 4], 3, seed=2, timeout=60.0)
+        admit_latency = time.monotonic() - t0
+        assert got.tokens == [int(t) for t in
+                              _expected(model, params, [3, 1, 4], 3, 0.0, 2)]
+        assert admit_latency < 15.0      # notify hop, not the 30s poll
+        t0 = time.monotonic()
+    assert time.monotonic() - t0 < 15.0  # stop() woke the idle wait

@@ -51,6 +51,16 @@ def _cases():
     tgt = jax.random.randint(jax.random.fold_in(k, 6), (s["N"],), 0, s["V"])
     qw = quantize(jax.random.normal(jax.random.fold_in(k, 7),
                                     (s["K"], s["V"])) * 0.05)
+    n_phys, ps = 9, 16                          # paged decode: T = 4 pages
+    pq = jax.random.normal(jax.random.fold_in(k, 8),
+                           (s["B"], s["H"], s["D"]), jnp.float32)
+    pk, pv = (jax.random.normal(jax.random.fold_in(k, 9 + i),
+                                (n_phys, ps, s["H"], s["D"]), jnp.float32)
+              for i in range(2))
+    bt = jax.random.permutation(
+        jax.random.fold_in(k, 11),
+        jnp.arange(n_phys, dtype=jnp.int32))[: s["B"] * 4].reshape(s["B"], 4)
+    lens = jnp.asarray([ps * 4, ps * 2 + 3], jnp.int32)
 
     calls = {
         ("attention", None): (lambda fn: fn(q, kk, v, causal=True),
@@ -60,6 +70,8 @@ def _cases():
         ("xent", None): (lambda fn: fn(x, head, tgt), (x, head, tgt)),
         ("int8_matmul", None): (lambda fn: fn(x[:, :s["K"]], qw),
                                 (x, qw.q, qw.scale)),
+        ("paged_attention", None): (lambda fn: fn(pq, pk, pv, bt, lens),
+                                    (pq, pk, pv, bt, lens, pq)),
     }
     for kind in registry.kinds():
         call, io = calls[(kind, None)]

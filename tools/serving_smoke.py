@@ -11,12 +11,22 @@ would see them, so this doubles as an end-to-end check that the serving
 histograms land.
 
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
-                                  [--lockguard]
+                                  [--lockguard] [--prefix-workload]
 
 ``--lockguard`` runs the whole smoke with instrumented threading locks
 (analysis/lockguard.py): lock-order inversions and Eraser-style unguarded
 shared writes observed anywhere in the engine/queue/HTTP path fail the
 run, and the violation count lands in the JSON result.
+
+``--prefix-workload`` switches to the paged/prefix-cache smoke: a
+Zipf-skewed population of shared system prompts (the multi-tenant
+chatbot shape) is served by a ``paged=True, prefix_cache=True`` engine
+while a background thread scrapes ``/metrics.prom`` exactly as a
+Prometheus poller would.  The JSON line reports p50/p99 latency, TTFT,
+the scraped prefix hit rate and peak KV pages in use, and the scraped
+peak device-KV bytes per occupied slot next to the dense
+``max_len``-per-slot baseline; the run FAILS unless the hit rate is
+positive and the paged footprint stays under the dense baseline.
 
 Exits nonzero if any request fails, the registry is missing a serving
 histogram, or lockguard saw a violation.
@@ -152,14 +162,193 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0,
     return result
 
 
+def _scrape_gauges(prom_text: str, names: tuple[str, ...]) -> dict:
+    """Parse plain ``name value`` gauge samples out of a Prometheus
+    exposition page (comments and histogram series skipped)."""
+    out: dict[str, float] = {}
+    for line in prom_text.splitlines():
+        if line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in names:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
+               page_size: int = 6, lockguard: bool = False) -> dict:
+    """The ``--prefix-workload`` leg: Zipf-shared system prompts against
+    a paged + prefix-cache engine, observed through real scrapes."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.serving import (InferenceEngine, ModelServer,
+                                            ServingClient, ServingConfig,
+                                            ServingError)
+
+    observability.enable()
+    METRICS.reset()
+
+    guard = None
+    if lockguard:
+        from deeplearning4j_tpu.analysis.lockguard import LockGuard
+
+        guard = LockGuard().install()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+    dense_bytes_per_slot = (cfg.max_len * cfg.n_heads * cfg.head_dim * 2
+                            * cfg.n_layers * jnp.dtype(cfg.dtype).itemsize)
+
+    rng = random.Random(seed)
+    # Zipf-skewed tenant population: a handful of shared system prompts
+    # (4 full pages each), rank-1 dominating — the shape prefix sharing
+    # exists for
+    n_tenants = 6
+    sys_prompts = [[rng.randrange(cfg.vocab_size)
+                    for _ in range(4 * page_size)] for _ in range(n_tenants)]
+    zipf_w = [1.0 / (r + 1) ** 1.5 for r in range(n_tenants)]
+    plans = []
+    for _ in range(requests):
+        tenant = rng.choices(range(n_tenants), weights=zipf_w)[0]
+        user = [rng.randrange(cfg.vocab_size)
+                for _ in range(rng.randint(1, 5))]
+        plans.append(dict(prompt=sys_prompts[tenant] + user,
+                          max_new_tokens=rng.randint(1, 8),
+                          temperature=rng.choice([0.0, 0.7]),
+                          seed=rng.randrange(1 << 20)))
+
+    failures: list[str] = []
+    statuses: list[int] = []
+    lock = threading.Lock()
+    scraped: dict[str, float] = {}       # name -> peak value seen
+    scrape_names = ("serving_prefix_hit_rate", "serving_kv_pages_in_use",
+                    "serving_kv_bytes_per_slot", "serving_kv_bytes")
+    done = threading.Event()
+
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=4, resolve_every=4, paged=True,
+                          page_size=page_size, prefix_cache=True))
+    with engine, ModelServer(engine=engine) as server:
+        client = ServingClient(port=server.port)
+
+        def scraper():
+            # a real Prometheus poller: GET /metrics.prom on an interval,
+            # keep the peaks (footprint claims come from scrapes, not
+            # from reaching into the engine)
+            while not done.is_set():
+                try:
+                    vals = _scrape_gauges(client.metrics_prom(),
+                                          scrape_names)
+                    with lock:
+                        for k, v in vals.items():
+                            scraped[k] = max(scraped.get(k, 0.0), v)
+                except ServingError:
+                    pass
+                done.wait(0.05)
+
+        def worker(mine):
+            for plan in mine:
+                try:
+                    out = client.generate(**plan)
+                    with lock:
+                        statuses.append(200)
+                    if len(out["tokens"]) > plan["max_new_tokens"]:
+                        with lock:
+                            failures.append(f"overlong answer for {plan}")
+                except ServingError as e:
+                    with lock:
+                        statuses.append(e.status)
+                        failures.append(str(e))
+
+        scrape_t = threading.Thread(target=scraper, daemon=True)
+        scrape_t.start()
+        ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        _time.sleep(0.1)                 # let eviction-fence gauges land
+        final = _scrape_gauges(client.metrics_prom(), scrape_names)
+        done.set()
+        scrape_t.join()
+        with lock:
+            for k, v in final.items():
+                scraped[k] = max(scraped.get(k, 0.0), v)
+
+    if guard is not None:
+        guard.uninstall()
+        guard.emit_metrics()
+        for v in guard.violations():
+            failures.append(str(v))
+
+    snap = METRICS.snapshot()
+    timers = snap["timers"]
+
+    def pct(name):
+        t = timers.get(name)
+        return {"p50": t["p50_s"], "p99": t["p99_s"], "count": t["count"],
+                "mean": t["mean_s"]} if t else None
+
+    hit_rate = scraped.get("serving_prefix_hit_rate", 0.0)
+    peak_bytes_per_slot = scraped.get("serving_kv_bytes_per_slot", 0.0)
+    result = {
+        "workload": "prefix",
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "page_size": page_size,
+        "completed": statuses.count(200),
+        "rejected": len(statuses) - statuses.count(200),
+        "request_latency_s": pct("serving.request_latency"),
+        "ttft_s": pct("serving.ttft"),
+        "prefix_hit_rate": hit_rate,
+        "kv_pages_in_use_peak": scraped.get("serving_kv_pages_in_use"),
+        "kv_bytes_per_slot_peak": peak_bytes_per_slot,
+        "dense_kv_bytes_per_slot": dense_bytes_per_slot,
+        "failures": failures[:5],
+    }
+    if guard is not None:
+        result["lockguard_violations"] = len(guard.violations())
+    assert not failures, failures[:5]
+    assert result["completed"] == requests
+    assert hit_rate > 0.0, "prefix cache never hit under a Zipf workload"
+    assert 0.0 < peak_bytes_per_slot < dense_bytes_per_slot, (
+        f"paged KV bytes/slot {peak_bytes_per_slot} not below dense "
+        f"baseline {dense_bytes_per_slot}")
+    return result
+
+
 def main(argv: list[str]) -> int:
     def arg(flag, default, cast=int):
         return cast(argv[argv.index(flag) + 1]) if flag in argv else default
 
-    print(json.dumps(run(requests=arg("--requests", 32),
+    if "--prefix-workload" in argv:
+        out = run_prefix(requests=arg("--requests", 32),
                          threads=arg("--threads", 4),
                          seed=arg("--seed", 0),
-                         lockguard="--lockguard" in argv)))
+                         page_size=arg("--page-size", 6),
+                         lockguard="--lockguard" in argv)
+    else:
+        out = run(requests=arg("--requests", 32),
+                  threads=arg("--threads", 4),
+                  seed=arg("--seed", 0),
+                  lockguard="--lockguard" in argv)
+    print(json.dumps(out))
     return 0
 
 

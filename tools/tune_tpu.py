@@ -356,6 +356,16 @@ def pallas_battery(iters=8, shapes=None):
     head = jnp.asarray(rng.standard_normal((K, V)) * 0.05, jnp.bfloat16)
     tgt = jnp.asarray(rng.integers(0, V, N), jnp.int32)
     qw = quantize(jnp.asarray(rng.standard_normal((K, V)) * 0.05))
+    # paged decode read: one query position per row over T-token pages
+    ps_pg = 16 if T >= 128 else 4
+    npg = -(-T // ps_pg)
+    n_phys = B * npg + 1
+    pg_q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+    pg_k, pg_v = (jnp.asarray(rng.standard_normal((n_phys, ps_pg, H, D)),
+                              jnp.bfloat16) for _ in range(2))
+    pg_bt = jnp.asarray(rng.permutation(n_phys)[: B * npg].reshape(B, npg),
+                        jnp.int32)
+    pg_len = jnp.asarray(rng.integers(1, npg * ps_pg + 1, B), jnp.int32)
 
     def grad_err(fn, ref, *args):
         def loss(f):
@@ -389,6 +399,12 @@ def pallas_battery(iters=8, shapes=None):
         b = float(cand.reference(x, head, tgt))
         return {"max_err": abs(a - b) / max(abs(b), 1e-9)}
 
+    def paged_check(cand):
+        o = cand.fn(pg_q, pg_k, pg_v, pg_bt, pg_len)
+        ref = cand.reference(pg_q, pg_k, pg_v, pg_bt, pg_len)
+        return {"max_err": float(np.max(np.abs(
+            np.asarray(o, np.float32) - np.asarray(ref, np.float32))))}
+
     def int8_check(cand):
         o = cand.fn(x, qw)
         ref = cand.reference(x, qw)
@@ -403,6 +419,9 @@ def pallas_battery(iters=8, shapes=None):
                                                        **blk), ln_check),
         ("xent", N, lambda fn, **blk: fn(x, head, tgt, **blk), xent_check),
         ("int8_matmul", N, lambda fn, **blk: fn(x, qw, **blk), int8_check),
+        ("paged_attention", B,
+         lambda fn, **blk: fn(pg_q, pg_k, pg_v, pg_bt, pg_len, **blk),
+         paged_check),
     )
     for kind, tokens, call, check in suites:
         for cand in registry.candidates(kind):
