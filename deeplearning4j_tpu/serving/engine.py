@@ -203,6 +203,9 @@ class InferenceEngine:
         self._slots: dict[int, _Slot] = {}           # guarded-by: self._lock
         self._slot_pages: dict[int, list[int]] = {}  # guarded-by: self._lock
         self._free: list[int] = list(range(cfg.slots))  # guarded-by: self._lock
+        # pages quarantined by an off-thread clear_prefix (reload): the
+        # serve thread wipes them at its next fence, then requeues them
+        self._pending_wipe: list[int] = []           # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._admitted = 0                           # guarded-by: self._lock
@@ -567,15 +570,48 @@ class InferenceEngine:
             self._thread.join(timeout=30.0)
             self._thread = None
         with self._lock:
-            dead = [self._slots.pop(s) for s in list(self._slots)]
-            pages = [self._slot_pages.pop(s, [])
-                     for s in list(self._slot_pages)]
-        for sl in dead:
+            dead = {s: self._slots.pop(s) for s in list(self._slots)}
+            pages = {s: self._slot_pages.pop(s, [])
+                     for s in list(self._slot_pages)}
+            # start() after stop() is supported: restore the FULL slot
+            # range — a dead slot's id must not leak out of the pool
+            self._free = list(range(self.cfg.slots))
+            pending, self._pending_wipe = self._pending_wipe, []
+        for sl in dead.values():
             sl.pending._fail(
                 RuntimeError("engine stopped with request in flight"))
-        if self._pool is not None:
-            for pg in pages:
-                self._pool.decref(pg)
+        # the serve thread is joined, so _state is safe to touch here.
+        # Reset the dead rows the way _evict would have — deactivate,
+        # release K/V and (paged) park the block tables on the trash
+        # page — so a restarted decode loop, which writes EVERY row's
+        # K/V through its table, can never scribble on pages the pool
+        # reallocates to new requests.
+        if dead or pending:
+            with allow_transfers():
+                if self.cfg.paged:
+                    freed = list(pending)
+                    for pg in pages.values():
+                        freed.extend(self._pool.decref(pg))
+                    bt = self._state["bt"]
+                    active = self._state["active"]
+                    for s in dead:
+                        bt = bt.at[s].set(self._num_pages)
+                        active = active.at[s].set(False)
+                    # graftlint: disable=LK01 — _state is serve-thread-
+                    # owned; the join above is the happens-before edge
+                    self._state = dict(self._state, bt=bt, active=active)
+                    self._wipe_pages(freed)
+                    if pending:
+                        self._pool.requeue(pending)
+                else:
+                    mask = np.zeros((self.cfg.slots,), bool)
+                    mask[list(dead)] = True
+                    self._state = dict(
+                        self._state,
+                        cache=reset_cache_slots(self._state["cache"],
+                                                jnp.asarray(mask)),
+                        active=self._state["active"]
+                        .at[jnp.asarray(list(dead), jnp.int32)].set(False))
         for p in self._queue.drain():
             p._fail(RuntimeError("engine stopped before request was admitted"))
 
@@ -609,8 +645,15 @@ class InferenceEngine:
             try:
                 if self.cfg.paged:
                     # slot 0 needs a real block-table row for the dummy
-                    # admits below; released (and re-trashed) in finally
-                    pages = self._pool.alloc(self._pages_per_slot)
+                    # admits below; released (and re-trashed) in finally.
+                    # A pool smaller than pages_per_slot is legal (sized
+                    # for short requests): warm with what it has — the
+                    # row's tail parks on the trash page, exactly like
+                    # an admitted short request's
+                    n_warm = min(self._pages_per_slot, self._num_pages)
+                    pages = self._pool.alloc(n_warm)
+                    row = pages + [self._num_pages] * (
+                        self._pages_per_slot - n_warm)
                     # graftlint: disable=LK01 — _state is serve-thread-
                     # owned; warmup (and every other flagged site) runs
                     # either before Thread.start() or ON the serve loop,
@@ -618,7 +661,7 @@ class InferenceEngine:
                     self._state = dict(
                         self._state,
                         bt=self._state["bt"].at[0].set(
-                            jnp.asarray(pages, jnp.int32)))
+                            jnp.asarray(row, jnp.int32)))
                 dparams = self._draft_params if self.cfg.speculative else {}
                 if self.cfg.speculative:
                     state, _ = self._step_fn(self._params, dparams,
@@ -670,6 +713,9 @@ class InferenceEngine:
                     dead = [self._slots.pop(s) for s in list(self._slots)]
                     self._slot_pages.clear()
                     self._free = list(range(self.cfg.slots))
+                    # pool.reset() below rebuilds the free list wholesale,
+                    # so quarantined page ids would go stale — drop them
+                    self._pending_wipe.clear()
                 for sl in dead:
                     sl.pending._fail(e)
                 if self._pool is not None:
@@ -677,7 +723,24 @@ class InferenceEngine:
                 with allow_transfers():
                     self._state = self._init_state()
 
+    def _drain_pending_wipe(self) -> None:
+        """Serve-thread half of reload's prefix invalidation: zero the
+        pages :meth:`PagePool.clear_prefix` quarantined and only THEN
+        hand them back to the free list.  Wipe-before-reallocatable —
+        the pages are not allocatable until ``requeue``, so they can
+        never be zeroed under a request that just acquired them; and
+        the wipe itself runs HERE because ``_state`` is serve-thread-
+        owned (reload must not touch it)."""
+        with self._lock:
+            pending, self._pending_wipe = self._pending_wipe, []
+        if not pending:
+            return
+        with allow_transfers():
+            self._wipe_pages(pending)
+        self._pool.requeue(pending)
+
     def _serve_once(self) -> None:
+        self._drain_pending_wipe()
         idle = not self._slots
         n_free = len(self._free)
         if n_free:
@@ -722,8 +785,16 @@ class InferenceEngine:
                             "serving.page_pool)")
                     usable = len(req.prompt) - 1
                     if self.cfg.prefix_cache:
-                        shared, cached_len = self._pool.lookup_prefix(
-                            req.prompt, usable)
+                        # the lookup is atomic with a params re-capture:
+                        # reload() swaps params AND clears the cache
+                        # under this same lock, so every entry seen here
+                        # holds K/V computed under exactly `params` — an
+                        # aliased prefix can never mix weights with the
+                        # prefill that extends it
+                        with self._lock:
+                            params = self._params
+                            shared, cached_len = self._pool.lookup_prefix(
+                                req.prompt, usable)
                         acquired.extend(shared)
                     # allocate for what THIS request can touch (prompt +
                     # budget, the engine writes positions [0, limit]),
@@ -752,8 +823,14 @@ class InferenceEngine:
                     jnp.int32(req.max_new_tokens))
                 if self.cfg.prefix_cache:
                     # publish every full-page chain of this prompt —
-                    # entries pin their pages with their own refcount
-                    self._pool.insert_prefix(req.prompt, acquired, usable)
+                    # entries pin their pages with their own refcount.
+                    # Skipped when a reload swapped params mid-prefill:
+                    # these pages hold OLD-weight K/V the just-cleared
+                    # cache must not re-learn
+                    with self._lock:
+                        if self._params is params:
+                            self._pool.insert_prefix(req.prompt, acquired,
+                                                     usable)
                     if cached_len:
                         METRICS.increment("serving.prefix_hits")
             except Exception as e:
@@ -938,7 +1015,12 @@ class InferenceEngine:
         in-flight segments finish on the params they dispatched with; the
         next dispatch reads the new tree.  Shapes are fixed by the config,
         so the swap hits the existing executables — no recompile, no
-        pause.  Returns the loaded step."""
+        pause.  With ``prefix_cache`` on, every cached chain is dropped
+        atomically with the swap (its K/V was computed under the old
+        weights — a request admitted after the reload must never alias
+        it); pages pinned only by the cache are wiped by the serve
+        thread at its next fence before becoming allocatable again.
+        Returns the loaded step."""
         if self._ckpt is None:
             raise RuntimeError("no checkpoint attached — nothing to reload")
         step = self._ckpt.latest_valid_step()
@@ -953,6 +1035,13 @@ class InferenceEngine:
         with self._lock:
             self._raw_params = restored["params"]
             self._params = new_params
+            if self._pool is not None and self.cfg.prefix_cache:
+                # same critical section as the swap: _admit's lookup
+                # (also under this lock) can never see old-weight
+                # entries next to the new params.  clear_prefix only
+                # QUARANTINES dead pages — reload runs off the serve
+                # thread and must not wipe device state itself
+                self._pending_wipe.extend(self._pool.clear_prefix())
         self._loaded_step = step
         METRICS.increment("serving.reloads")
         METRICS.gauge("serving.loaded_step", step)

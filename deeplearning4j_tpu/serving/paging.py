@@ -149,16 +149,44 @@ class PagePool:
         with self._lock:
             return self._decref_locked(pages)
 
-    def _decref_locked(self, pages) -> list[int]:
+    def _decref_locked(self, pages, quarantine: bool = False) -> list[int]:
+        """With ``quarantine=True`` dead pages are reported but NOT
+        pushed on the free list — the caller owns getting them wiped and
+        handed back through :meth:`requeue`."""
         freed: list[int] = []
         for p in pages:
             if self._ref[p] <= 0:
                 raise AssertionError(f"page {p} refcount underflow")
             self._ref[p] -= 1
             if self._ref[p] == 0:
-                self._free.append(p)
+                if not quarantine:
+                    self._free.append(p)
                 freed.append(p)
         return freed
+
+    def clear_prefix(self) -> list[int]:
+        """Drop EVERY prefix entry — hot-reload invalidation: cached
+        chains hold K/V computed under superseded weights, and a request
+        that aliased one after a param swap would serve tokens matching
+        neither the old nor the new model.  Pages whose cache pin was
+        the last reference are QUARANTINED (removed from the books but
+        NOT reallocatable) and returned so the engine's serve thread can
+        zero them before :meth:`requeue` makes them allocatable again —
+        wipe-before-reallocatable, so a cleared page can never be zeroed
+        under a reader that just acquired it.  Pages still aliased by
+        live slots stay pinned by their readers, untouched."""
+        with self._lock:
+            quarantined: list[int] = []
+            for key in list(self._prefix):
+                entry = self._prefix.pop(key)
+                quarantined.extend(
+                    self._decref_locked(list(entry.pages), quarantine=True))
+            return quarantined
+
+    def requeue(self, pages: list[int]) -> None:
+        """Return quarantined (now wiped) pages to the free list."""
+        with self._lock:
+            self._free.extend(pages)
 
     def _evict_lru_locked(self) -> bool:
         """Drop the least-recently-touched prefix entry (its pin only —

@@ -26,6 +26,7 @@ from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                    init_decode_cache,
                                                    init_paged_cache)
 from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
 from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
 from deeplearning4j_tpu.serving import (InferenceEngine, PagePool,
                                         PagePoolExhausted, ServingConfig)
@@ -332,6 +333,122 @@ def test_page_pool_chaos_site_fixed_seed(lm):
             assert engine.generate([4, 5, 6], 5, seed=13).tokens == want
             assert engine._pool.free_count() == engine._pool.num_pages
     assert METRICS.snapshot()["counters"]["serving.page_pool_exhausted"] == 1
+
+
+# -------------------------------------------------------------- hot reload
+def test_reload_invalidates_prefix_cache(lm, tmp_path):
+    """Hot-swap must drop every cached prefix chain: the entries hold
+    K/V computed under the OLD weights, and a request admitted after the
+    reload that aliased them would emit tokens matching neither model.
+    Post-reload shared-prefix traffic must be bitwise the NEW params'
+    offline sample, and the cache re-learns under the new weights."""
+    model, params_old = lm
+    params_new = model.init(jax.random.key(1234))
+    mgr = CheckpointManager(tmp_path / "ck", keep=3)
+    mgr.save(1, params_old)
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1]           # 2 full pages at ps=4
+    plans = [(sys_prompt + [t], 4, 0.0, 11 + t) for t in (1, 2)]
+    engine = InferenceEngine(
+        model, checkpoint=str(tmp_path / "ck"),
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                          prefix_cache=True))
+    with engine:
+        got = [engine.generate(p, n, temperature=t, seed=s, timeout=120.0)
+               .tokens for p, n, t, s in plans]
+        assert got == [_expected(model, params_old, p, n, t, s)
+                       for p, n, t, s in plans]
+        assert engine.stats()["prefix_entries"] > 0
+        mgr.save(2, params_new)
+        assert engine.reload() == 2
+        assert engine.stats()["prefix_entries"] == 0   # old-weight chains gone
+        got = [engine.generate(p, n, temperature=t, seed=s, timeout=120.0)
+               .tokens for p, n, t, s in plans]
+        assert got == [_expected(model, params_new, p, n, t, s)
+                       for p, n, t, s in plans]
+        assert engine.stats()["prefix_entries"] > 0    # re-learned, new weights
+    # nothing leaked: every non-free page is a (new-weights) cache pin
+    pinned = engine._pool.in_use()
+    assert engine._pool.free_count() == engine._pool.num_pages - pinned
+
+
+@pytest.mark.lockguard
+def test_clear_prefix_quarantines_until_requeue():
+    """Pool-level reload invalidation: clear_prefix unpins every chain.
+    A page a live slot still aliases survives untouched; a page whose
+    cache pin was the last reference is quarantined — NOT reallocatable
+    — until the caller wipes it and hands it back with requeue."""
+    pool = PagePool(num_pages=4, page_size=2)
+    a = pool.alloc(2)
+    pool.insert_prefix([1, 2, 3, 4, 5], a, usable=4)
+    shared, cached = pool.lookup_prefix([1, 2, 3, 4, 5], usable=4)
+    assert shared == a and cached == 4
+    pool.decref(a)                       # original slot done; alias remains
+    assert pool.clear_prefix() == [] and pool.prefix_entries() == 0
+    assert pool.refcount(a[0]) == 1      # the alias keeps the page alive
+    pool.decref(shared)                  # last reader: frees normally
+    assert pool.free_count() == pool.num_pages
+    # no alias left: the cleared pages quarantine until requeued
+    b = pool.alloc(2)
+    pool.insert_prefix([7, 7, 7, 7, 7], b, usable=4)
+    pool.decref(b)                       # only the cache pins remain
+    dead = pool.clear_prefix()
+    assert sorted(dead) == sorted(b)
+    assert pool.free_count() == 2        # quarantined pages NOT handed out
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc(3)
+    pool.requeue(dead)
+    assert pool.free_count() == pool.num_pages
+
+
+# ------------------------------------------------------------ stop/restart
+def test_stop_with_inflight_then_restart_serves_clean(lm):
+    """stop() with a request mid-decode must fail that caller AND fully
+    reset the bookkeeping: after start() the engine has its whole slot
+    range back, and the dead request's block-table row — which the
+    decode step writes through whether the row is active or not — is
+    parked on the trash page, never on pages reallocated to new traffic
+    (served tokens stay bitwise the offline sample's)."""
+    model, params = lm
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4))
+    inflight = engine.submit([5, 1, 4], 25, seed=3)    # too long to finish
+    engine._serve_once()     # admit + one 2-step segment: mid-decode
+    assert engine._slots
+    engine.stop()
+    with pytest.raises(RuntimeError, match="request in flight"):
+        inflight.result(0)
+    assert engine._pool.free_count() == engine._pool.num_pages
+    with engine._lock:
+        assert sorted(engine._free) == [0, 1]          # full slot range back
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    handles = [engine.submit(p, n, temperature=t, seed=s)
+               for p, n, t, s in PLANS]
+    with engine:
+        got = [h.result(120.0).tokens for h in handles]
+    assert got == want
+    assert engine._pool.free_count() == engine._pool.num_pages
+
+
+# --------------------------------------------------------------- small pool
+def test_warmup_and_serving_with_pool_smaller_than_max_len(lm):
+    """A pool sized below pages_per_slot (legal: short-prompt traffic on
+    a tight memory budget) must not wedge start(): warmup warms with the
+    pages it has, short requests serve with bitwise parity, and an
+    oversized request 429s at admission instead."""
+    model, params = lm
+    scfg = ServingConfig(slots=1, resolve_every=2, paged=True, page_size=4,
+                         num_pages=3)   # 12 positions; pages_per_slot is 8
+    want = [int(t) for t in _expected(model, params, [3, 1, 4], 5, 0.0, 2)]
+    engine = InferenceEngine(model, params=params, cfg=scfg)
+    with engine:             # start() warms up: must not exhaust the pool
+        assert engine.generate([3, 1, 4], 5, seed=2,
+                               timeout=120.0).tokens == want
+        big = engine.submit([1] * 10, 8, seed=0)       # needs 5 pages > 3
+        with pytest.raises(PagePoolExhausted) as ei:
+            big.result(120.0)
+        assert ei.value.status == 429
+    assert engine._pool.free_count() == engine._pool.num_pages
 
 
 # ------------------------------------------------------------------ wakeup
