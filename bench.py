@@ -432,16 +432,16 @@ def _bert_leg(dev, on_tpu, conserve_hbm=False):
         params, opt, loss = step(params, opt, a, b)
         warm_loss = _host_float(loss)
 
-        # XLA's own FLOPs estimate for one step (independent cross-check)
-        xla_flops = None
-        try:
-            cost = step.lower(params, opt, a, b).compile().cost_analysis()
-            if cost:
-                c = cost[0] if isinstance(cost, (list, tuple)) else cost
-                f = float(c.get("flops", 0.0))
-                xla_flops = f if f > 0 else None   # -1 = XLA "unknown"
-        except Exception:
-            pass
+        # XLA's own FLOPs estimate for one step (independent cross-check),
+        # captured through the observability cost model so the artifact and
+        # a live ``train.mfu`` scrape come from the SAME accounting
+        from deeplearning4j_tpu.observability import COSTS
+        cost_info = COSTS.capture(
+            "bench.bert_base.step", step, params, opt, a, b,
+            analytic_flops=cfg.flops_per_token() * batch * seq)
+        xla_flops = (cost_info.flops
+                     if cost_info is not None and cost_info.source == "xla"
+                     else None)
 
         # end-to-end first (device_put serialized into each step), then the
         # double-buffered production pipeline, then the device-staged run
@@ -1012,6 +1012,13 @@ def main():
     bert_problems, bert_mfu = _validity_checks(
         "bert", bert["iter_times"], bert["flops_per_iter"], peak)
     problems += bert_problems
+    # live gauges from the same cost_analysis-derived FLOPs the artifact
+    # cross-checks against (PR-10): a /metrics scrape during a bench run
+    # sees train.mfu computed exactly as the JSON line reports it
+    from deeplearning4j_tpu.observability import COSTS
+    bert_mfu_xla = COSTS.publish_utilization(
+        COSTS.get("bench.bert_base.step"), bert["stats"]["median_s"],
+        "train.mfu", "train.mbu")
     # the e2e leg serializes a device_put into every step, so it should be
     # an upper bound on the staged step time; e2e beating staged by more
     # than noise (r4 saw a 5% inversion) means the timing model is off for
@@ -1114,6 +1121,8 @@ def main():
         "attention": bert["attention"],
         "attention_choice": bert.get("attention_choice"),
         "flops_per_token": round(bert["flops_per_token_analytic"]),
+        **({"mfu_xla": round(bert_mfu_xla, 6)}
+           if bert_mfu_xla is not None else {}),
         **({"flops_analytic_over_xla": bert["flops_analytic_over_xla"]}
            if "flops_analytic_over_xla" in bert else {}),
         "resnet": ({"images_per_sec_per_chip": round(resnet["images_per_sec"], 2),

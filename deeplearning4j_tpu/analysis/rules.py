@@ -1,4 +1,4 @@
-"""The graftlint rule set — fourteen hazard classes from this repo's history.
+"""The graftlint rule set — fifteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -37,6 +37,9 @@
 | PG01  | KV page acquire (`alloc`/`incref`/`lookup_prefix` on a page      |
 |       | pool, `serving/` modules) with no `decref`-style release on the  |
 |       | exceptional exit paths — leaked pinned pages 429 the pool        |
+| OB01  | direct `time.monotonic()`/`perf_counter()` timing of dispatch    |
+|       | in `serving/`/`parallel/` with no registry/tracer call in reach  |
+|       | — the measurement exists nowhere a scrape or trace can see       |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -85,7 +88,7 @@ _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
 #: observability markers — any of these in reach means the loop reports
 #: through the PR 1 layer
 _OBS_MARKERS = ("span", "observe_time", "observe_many", "increment",
-                "gauge", "time", "iteration_done")
+                "gauge", "time", "iteration_done", "record_span")
 _OBS_BASES = ("trace", "METRICS", "TRACER", "registry", "self.registry")
 
 
@@ -1136,3 +1139,66 @@ class PageLeakRule(Rule):
                                 and sub.func.attr in _PG_RELEASE:
                             return True
             node = parent
+
+
+@register
+class UnregisteredTimingRule(Rule):
+    """OB01 — hand-rolled dispatch timing that bypasses the registry.
+
+    A function in ``serving/`` or ``parallel/`` that reads
+    ``time.monotonic()``/``time.perf_counter()`` around a device-
+    dispatching call but never reports through the observability layer
+    (``METRICS``/``trace``/``record_span``) produces a measurement that
+    exists nowhere: no histogram, no ``/metrics.prom`` scrape, no trace
+    event.  PR 10's tracing/MFU accounting derives everything from
+    registry observations — a private clock read next to a dispatch is
+    the sign a hot path grew its own timing instead of feeding the
+    registry (how the pre-PR-1 hot loops went dark).  One registry or
+    tracer call anywhere in the function satisfies the rule, exactly
+    like HOT02.
+
+    Blind spots: a clock read in one function passed to a helper that
+    times/dispatches in another; a dispatch hidden behind an attribute
+    the jit-facts pass cannot resolve.  Silence deliberate raw timing
+    with ``# graftlint: disable=OB01`` plus the reason.
+    """
+
+    id = "OB01"
+    title = "dispatch timing bypasses the observability registry"
+
+    _CLOCKS = {"time.monotonic", "time.monotonic_ns",
+               "time.perf_counter", "time.perf_counter_ns"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "serving/" not in path and "parallel/" not in path:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if UninstrumentedHotLoopRule._has_obs(node, module):
+                continue
+            clock = None
+            for call in _calls_in(node):
+                name = (module.canonical(call.func)
+                        or dotted_name(call.func) or "")
+                if name in self._CLOCKS:
+                    clock = call
+                    break
+            if clock is None:
+                continue
+            dispatches = None
+            for call in _calls_in(node):
+                callee = dotted_name(call.func)
+                if callee and module.is_dispatching_call(callee):
+                    dispatches = callee
+                    break
+            if dispatches is None:
+                continue
+            yield self.finding(
+                module, clock,
+                f"function times device dispatch ({dispatches!r}) with a "
+                "raw monotonic/perf_counter read and never reports through "
+                "METRICS/trace — the measurement is invisible to scrapes "
+                "and traces; record it via METRICS.observe_time/time() or "
+                "trace.record_span (or silence with a reason)")

@@ -3,19 +3,28 @@
 The production observability layer (grown from the seed
 ``parallel/observe.py``; that module remains as a compat shim):
 
-- ``trace`` (module alias) / ``span`` — nestable spans with contextvar
+- ``trace`` (module alias) / ``span`` — nestable spans with trace identity
+  (``trace_id``/``span_id``/``parent_id``, W3C ``traceparent`` propagation
+  via ``trace.bind``/``trace.current_traceparent``), contextvar
   propagation, Chrome-trace (Perfetto) + JSONL export (``tracing``)
 - ``METRICS`` / ``MetricsRegistry`` — counters, gauges, timing histograms
   with p50/p95/p99, Prometheus text exposition (``metrics``)
+- ``COSTS`` / ``CostModel`` — XLA ``cost_analysis()`` FLOPs/bytes per
+  compiled signature; live ``*.mfu`` / ``*.mbu`` gauges (``cost``)
+- ``FLIGHTREC`` — bounded rings of recent spans/metric deltas/chaos fires,
+  dumped to a JSON bundle on failure triggers (``flightrec``)
 - ``StatusServer`` — ``/healthz`` ``/metrics`` ``/metrics.prom`` ``/status``
-- ``sample_device_memory`` — per-device HBM gauges
+- ``sample_device_memory`` — per-device HBM gauges (no-op gauge on
+  backends without memory stats)
 - ``enabled``/``enable``/``disable`` — process-global flag;
   zero-per-step-allocation when off (see ``core``)
 """
 
 from . import tracing as trace
 from .core import NOOP_SPAN, disable, enable, enabled
+from .cost import COSTS, CostInfo, CostModel
 from .device import sample_device_memory, sample_state_bytes
+from .flightrec import FLIGHTREC, FlightRecorder
 from .metrics import (
     DEFAULT_TIME_BUCKETS,
     METRICS,
@@ -27,7 +36,8 @@ from .server import StatusServer
 from .tracing import TRACER, Tracer, profiler_trace, span
 
 __all__ = [
-    "DEFAULT_TIME_BUCKETS", "Histogram", "METRICS", "MetricsRegistry",
+    "COSTS", "CostInfo", "CostModel", "DEFAULT_TIME_BUCKETS", "FLIGHTREC",
+    "FlightRecorder", "Histogram", "METRICS", "MetricsRegistry",
     "NOOP_SPAN", "StatusServer", "StepTimer", "TRACER", "Tracer",
     "disable", "enable", "enabled", "profiler_trace",
     "sample_device_memory", "sample_state_bytes", "span", "trace",

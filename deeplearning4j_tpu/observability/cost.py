@@ -1,0 +1,207 @@
+"""Analytic utilization accounting from XLA ``cost_analysis()``.
+
+``COSTS.capture("train_step.b128", step_fn, *args)`` lowers+compiles the
+jitted fn for the concrete arg shapes, pulls XLA's cost analysis (FLOPs and
+bytes accessed), and caches the result per (key, arg-signature) — so the
+second trace is paid once per compiled signature, amortized by the
+persistent XLA compile cache.  Combined with a measured wall time, the
+result publishes live utilization gauges:
+
+    ``{prefix}.mfu``  = flops / (seconds * peak_flops)
+    ``{prefix}.mbu``  = bytes_accessed / (seconds * peak_bytes_per_s)
+
+the same accounting bench.py reports, so artifact and ``/metrics.prom``
+agree.  Caveats (see DESIGN.md §18): some backends return no
+``cost_analysis`` or report ``flops <= 0`` ("unknown"); ``capture`` then
+falls back to a caller-supplied analytic FLOPs estimate, or returns None —
+callers must treat None as "no utilization numbers", never an error.
+
+Capturing is safe before a donating call: ``fn.lower(*args)`` reads only
+shapes/dtypes and does not consume donated buffers.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+from . import core
+from .metrics import METRICS
+
+# Nominal peak numbers keyed by substring of ``device_kind.lower()``.
+# The TPU rows mirror bench.py's PEAK_FLOPS table (v5e bf16); the CPU rows
+# are nominal single-socket figures so CPU test runs produce finite, small
+# MFU values rather than NaN.
+PEAK_FLOPS: dict[str, float] = {
+    "tpu v5 lite": 197e12,
+    "tpu v5": 197e12,
+    "tpu": 197e12,
+    "cpu": 5e10,
+}
+PEAK_BYTES_PER_S: dict[str, float] = {
+    "tpu v5 lite": 819e9,   # v5e HBM bandwidth
+    "tpu v5": 819e9,
+    "tpu": 819e9,
+    "cpu": 2e10,
+}
+
+
+def _lookup(table: dict[str, float], kind: str) -> float | None:
+    kind = kind.lower()
+    for key in sorted(table, key=len, reverse=True):
+        if key in kind:
+            return table[key]
+    return None
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+@dataclass(frozen=True)
+class CostInfo:
+    """Per-execution cost of one compiled fn (whole program, all devices)."""
+    flops: float
+    bytes_accessed: float
+    source: str  # "xla" | "analytic"
+
+
+def _signature(args: tuple) -> tuple:
+    """Hashable (shape, dtype) signature of a concrete arg tree."""
+    import jax
+    sig = []
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            sig.append((type(leaf).__name__, repr(leaf)[:32]))
+    return tuple(sig)
+
+
+def _extract(analysis: Any) -> tuple[float, float]:
+    """Pull (flops, bytes_accessed) out of ``cost_analysis()``'s return,
+    which is a dict on some backends and a list of per-program dicts on
+    others.  Missing/garbage values come back as 0.0."""
+    if analysis is None:
+        return 0.0, 0.0
+    entries = analysis if isinstance(analysis, (list, tuple)) else [analysis]
+    flops = 0.0
+    nbytes = 0.0
+    for entry in entries:
+        if not isinstance(entry, dict):
+            continue
+        try:
+            f = float(entry.get("flops", 0.0))
+            if math.isfinite(f) and f > 0:
+                flops += f
+        except (TypeError, ValueError):
+            pass
+        try:
+            b = float(entry.get("bytes accessed", 0.0))
+            if math.isfinite(b) and b > 0:
+                nbytes += b
+        except (TypeError, ValueError):
+            pass
+    return flops, nbytes
+
+
+class CostModel:
+    """Caches per-compiled-signature cost; publishes utilization gauges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache: dict[tuple, CostInfo | None] = {}
+        self._by_key: dict[str, CostInfo] = {}
+
+    # ------------------------------------------------------------- capture
+    def capture(self, key: str, fn, *args,
+                analytic_flops: float | None = None) -> CostInfo | None:
+        """Cost of ``fn(*args)`` for these concrete arg shapes, from XLA's
+        cost analysis (cached per signature).  ``fn`` must be jitted (have
+        ``.lower``).  Never raises; returns None when no cost is knowable
+        and no ``analytic_flops`` fallback was given."""
+        if not core.enabled():
+            return None
+        try:
+            sig = (key,) + _signature(args)
+        except Exception:
+            return None
+        with self._lock:
+            if sig in self._cache:
+                info = self._cache[sig]
+                if info is not None:
+                    self._by_key[key] = info
+                return info
+        info = None
+        try:
+            compiled = fn.lower(*args).compile()
+            flops, nbytes = _extract(compiled.cost_analysis())
+            if flops > 0:
+                info = CostInfo(flops, nbytes, "xla")
+        except Exception:
+            info = None
+        if info is None and analytic_flops is not None and analytic_flops > 0:
+            info = CostInfo(float(analytic_flops), 0.0, "analytic")
+        with self._lock:
+            self._cache[sig] = info
+            if info is not None:
+                self._by_key[key] = info
+        return info
+
+    def put(self, key: str, info: CostInfo) -> None:
+        """Install an externally computed cost under ``key``."""
+        with self._lock:
+            self._by_key[key] = info
+
+    def get(self, key: str) -> CostInfo | None:
+        """Most recently captured cost for ``key`` (any signature)."""
+        with self._lock:
+            return self._by_key.get(key)
+
+    # ------------------------------------------------------------- peaks
+    def peak_flops(self) -> float | None:
+        return _lookup(PEAK_FLOPS, _device_kind())
+
+    def peak_bytes_per_s(self) -> float | None:
+        return _lookup(PEAK_BYTES_PER_S, _device_kind())
+
+    # ------------------------------------------------------------- publish
+    def publish_utilization(self, info: CostInfo | None, seconds: float,
+                            mfu_gauge: str, mbu_gauge: str | None = None,
+                            registry=None) -> float | None:
+        """Gauge ``mfu_gauge`` (and ``mbu_gauge`` when bytes are known)
+        from one execution's cost and measured wall seconds.  Returns the
+        MFU value, or None when nothing could be published."""
+        if info is None or not (seconds > 0) or not core.enabled():
+            return None
+        reg = registry if registry is not None else METRICS
+        mfu = None
+        peak_f = self.peak_flops()
+        if peak_f and info.flops > 0:
+            mfu = info.flops / (seconds * peak_f)
+            if math.isfinite(mfu):
+                reg.gauge(mfu_gauge, mfu)
+            else:
+                mfu = None
+        peak_b = self.peak_bytes_per_s()
+        if mbu_gauge and peak_b and info.bytes_accessed > 0:
+            mbu = info.bytes_accessed / (seconds * peak_b)
+            if math.isfinite(mbu):
+                reg.gauge(mbu_gauge, mbu)
+        return mfu
+
+    def clear(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self._by_key.clear()
+
+
+COSTS = CostModel()

@@ -23,7 +23,11 @@ _KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
 
 
 def sample_device_memory(registry: MetricsRegistry = METRICS) -> int:
-    """Gauge per-device memory stats; returns how many devices reported."""
+    """Gauge per-device memory stats; returns how many devices reported.
+
+    On backends without memory stats (CPU) this degrades to a no-op gauge:
+    ``device.memory_stats_supported`` is published as 0.0 and no exception
+    ever escapes, so instrumented paths call this unconditionally."""
     if not core.enabled():
         return 0
     try:
@@ -39,11 +43,15 @@ def sample_device_memory(registry: MetricsRegistry = METRICS) -> int:
             stats = None
         if not stats:
             continue
+        try:
+            prefix = f"device.{d.id}."
+            for k in _KEYS:
+                if k in stats:
+                    registry.gauge(prefix + k, float(stats[k]))
+        except Exception:
+            continue
         reported += 1
-        prefix = f"device.{d.id}."
-        for k in _KEYS:
-            if k in stats:
-                registry.gauge(prefix + k, float(stats[k]))
+    registry.gauge("device.memory_stats_supported", float(reported))
     return reported
 
 

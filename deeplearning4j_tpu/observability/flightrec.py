@@ -1,0 +1,148 @@
+"""Failure flight recorder: bounded rings of recent activity, dumped to a
+timestamped JSON bundle when something goes wrong.
+
+The recorder passively listens to the tracer (every completed span) and
+the metrics registry (counter/gauge mutations — chaos-site fires arrive
+as ``faults.injected.<site>`` counter increments), keeping only the last
+few hundred entries.  On a triggering failure — ``DivergenceError``,
+supervisor retry, ``CheckpointCorruptError``, a serving 429 burst —
+``dump(trigger, extra=...)`` writes everything plus a full metrics
+snapshot to ``<dump_dir>/flightrec-<trigger>-<ms>.json``, so the moments
+*before* the crash survive the crash.
+
+Zero-overhead contract: every record method returns before touching the
+lock when observability is disabled; the listeners are registered once at
+import and see nothing while disabled (the tracer/registry short-circuit
+upstream).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+from . import core
+from .metrics import METRICS
+from .tracing import TRACER
+
+_SPAN_RING = 256
+_METRIC_RING = 512
+_FAULT_RING = 64
+_429_RING = 64
+
+
+class FlightRecorder:
+    """Bounded rings of recent spans / metric deltas / chaos fires."""
+
+    def __init__(self, dump_dir: str | Path | None = None):
+        self._lock = threading.Lock()
+        self.spans: deque[dict[str, Any]] = deque(maxlen=_SPAN_RING)
+        self.metric_events: deque[tuple[str, str, float]] = deque(
+            maxlen=_METRIC_RING)
+        self.faults: deque[dict[str, Any]] = deque(maxlen=_FAULT_RING)
+        self._429s: deque[float] = deque(maxlen=_429_RING)
+        self._last_burst_dump = 0.0
+        self.burst_n = 8            # 429s ...
+        self.burst_window_s = 2.0   # ... within this window -> dump
+        self.burst_cooldown_s = 30.0
+        self.dump_dir = Path(
+            dump_dir if dump_dir is not None
+            else os.environ.get("DL4J_TPU_FLIGHTREC_DIR", "flightrec"))
+        self._seq = 0
+
+    # ------------------------------------------------------------- listeners
+    def record_span(self, ev: dict[str, Any]) -> None:
+        """Tracer listener: keep a compact copy of each completed span."""
+        if not core.enabled():
+            return
+        args = ev.get("args") or {}
+        rec = {"name": ev["name"], "ts": ev["ts"], "dur": ev["dur"],
+               "trace_id": args.get("trace_id")}
+        err = args.get("error")
+        if err:
+            rec["error"] = err
+        step = args.get("step")
+        if step is not None:
+            rec["step"] = step
+        with self._lock:
+            self.spans.append(rec)
+
+    def record_metric(self, kind: str, name: str, value: float) -> None:
+        """Registry listener: counter/gauge deltas; chaos-site fires show
+        up as ``faults.injected.<site>`` counter increments."""
+        if not core.enabled():
+            return
+        with self._lock:
+            self.metric_events.append((kind, name, value))
+            if name.startswith("faults.injected."):
+                self.faults.append({"site": name[len("faults.injected."):],
+                                    "time": time.time()})
+
+    # ------------------------------------------------------------- triggers
+    def note_429(self) -> Path | None:
+        """Record one backpressure rejection; dump on a burst (``burst_n``
+        within ``burst_window_s``, rate-limited by ``burst_cooldown_s``)."""
+        if not core.enabled():
+            return None
+        now = time.monotonic()
+        with self._lock:
+            self._429s.append(now)
+            burst = (len(self._429s) >= self.burst_n
+                     and now - self._429s[-self.burst_n] <= self.burst_window_s
+                     and now - self._last_burst_dump >= self.burst_cooldown_s)
+            if burst:
+                self._last_burst_dump = now
+        if burst:
+            return self.dump("serving_429_burst",
+                             extra={"rejections_in_window": self.burst_n,
+                                    "window_s": self.burst_window_s})
+        return None
+
+    # ------------------------------------------------------------- dump
+    def dump(self, trigger: str, extra: dict[str, Any] | None = None
+             ) -> Path | None:
+        """Write the rings + a metrics snapshot to a timestamped bundle.
+        Never raises (a broken disk must not mask the original failure)."""
+        if not core.enabled():
+            return None
+        try:
+            with self._lock:
+                self._seq += 1
+                bundle = {
+                    "trigger": trigger,
+                    "time": time.time(),
+                    "spans": list(self.spans),
+                    "metric_events": [list(e) for e in self.metric_events],
+                    "faults": list(self.faults),
+                    "extra": extra or {},
+                }
+                seq = self._seq
+            bundle["metrics"] = METRICS.snapshot()
+            self.dump_dir.mkdir(parents=True, exist_ok=True)
+            name = f"flightrec-{trigger}-{int(time.time() * 1000)}-{seq}.json"
+            path = self.dump_dir / name
+            path.write_text(json.dumps(bundle, default=str))
+            return path
+        except Exception:
+            return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.metric_events.clear()
+            self.faults.clear()
+            self._429s.clear()
+            self._last_burst_dump = 0.0
+
+
+FLIGHTREC = FlightRecorder()
+
+# Passive wiring: the recorder sees every completed span and every
+# counter/gauge mutation for the life of the process.
+TRACER.add_listener(FLIGHTREC.record_span)
+METRICS.add_listener(FLIGHTREC.record_metric)

@@ -129,6 +129,20 @@ class MetricsRegistry:
         self.counters: dict[str, float] = defaultdict(float)
         self.gauges: dict[str, float] = {}
         self.timers: dict[str, Histogram] = {}
+        # Called as fn(kind, name, value) after counter/gauge mutation,
+        # outside the registry lock (the flight recorder takes its own lock).
+        # Timing observations are deliberately not forwarded — too hot.
+        self._listeners: list = []
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, kind: str, name: str, value: float) -> None:
+        for fn in self._listeners:
+            try:
+                fn(kind, name, value)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------- mutation
     def increment(self, name: str, by: float = 1.0) -> None:
@@ -136,12 +150,16 @@ class MetricsRegistry:
             return
         with self._lock:
             self.counters[name] += by
+        if self._listeners:
+            self._notify("counter", name, by)
 
     def gauge(self, name: str, value: float) -> None:
         if not core.enabled():
             return
         with self._lock:
             self.gauges[name] = value
+        if self._listeners:
+            self._notify("gauge", name, value)
 
     def observe_time(self, name: str, seconds: float,
                      buckets: Iterable[float] | None = None) -> None:

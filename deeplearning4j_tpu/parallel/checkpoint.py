@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..observability import METRICS, trace
+from ..observability import FLIGHTREC, METRICS, trace
 from ..resilience.faults import FAULTS, corrupt_file
 
 
@@ -243,6 +243,8 @@ class CheckpointManager:
         if step is not None:
             if not self.verify(step):
                 METRICS.increment("checkpoint.corrupt_detected")
+                FLIGHTREC.dump("checkpoint_corrupt", extra={
+                    "step": int(step), "directory": str(self.directory)})
                 raise CheckpointCorruptError(step, self.directory)
         else:
             steps = self.all_steps()

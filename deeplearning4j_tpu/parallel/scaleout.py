@@ -75,6 +75,10 @@ class Job:
     job_id: str = ""
     attempts: int = 0
     last_error: str = ""
+    # distributed-trace identity: stamped by the master at dispatch, bound
+    # on the worker around perform() — one run renders as one flame
+    trace_id: str = ""
+    parent_span_id: str = ""
 
 
 class JobIterator(Protocol):
@@ -519,7 +523,10 @@ class DistributedRunner:
             if slow is not None:
                 time.sleep(slow.delay_s)
             try:
-                with METRICS.time("scaleout.job"):
+                with METRICS.time("scaleout.job"), \
+                        trace.bind(job.trace_id, job.parent_span_id), \
+                        trace.span("scaleout.perform", worker=worker_id,
+                                   attempts=job.attempts):
                     FAULTS.maybe_fire("scaleout.perform")
                     performer.perform(job)
             except WorkerKilled:
@@ -665,6 +672,12 @@ class DistributedRunner:
                     else:
                         continue
                     job.worker_id = wid
+                    if not job.trace_id:
+                        # the scaleout.run span is open on this thread, so
+                        # dispatched jobs inherit the run's trace identity
+                        ctx = trace.current_trace_context()
+                        if ctx is not None:
+                            job.trace_id, job.parent_span_id = ctx
                     self.tracker.add_job(job)
                     self._dispatched_at[wid] = time.time()
                     METRICS.increment("scaleout.jobs_dispatched")

@@ -62,7 +62,7 @@ except ImportError:  # pragma: no cover - older jax
 from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..datasets.dataset import DataSet
 from ..resilience.faults import FAULTS, DivergenceError
-from ..observability import METRICS, NOOP_SPAN, enabled as _obs_enabled
+from ..observability import COSTS, METRICS, NOOP_SPAN, enabled as _obs_enabled
 from ..observability import sample_device_memory, sample_state_bytes, trace
 from ..optimize import transforms as tfm
 from . import collectives as clv
@@ -180,6 +180,9 @@ class DataParallelTrainer:
         self._pending: list[tuple[LazyLoss, int, int]] = []
         self._window_t0: float | None = None
         self._nan_guard = False  # set per-fit; checked at resolution
+        # XLA cost of the most recent bucket's dispatch (captured at first
+        # compile) — feeds the live train.mfu gauge at resolution fences
+        self._step_cost = None
         setup_compile_cache()  # persistent XLA cache (env-gated no-op)
 
     # ------------------------------------------------------------------ state
@@ -505,11 +508,10 @@ class DataParallelTrainer:
             x = jax.device_put(x, self._batch_sh)
             y = jax.device_put(y, self._batch_sh)
             if self.router == "iterative_reduce":
-                params, tstate, loss = step_fn(
-                    state.params, state.tstate, x, y,
-                    jax.device_put(sub, self._rep_sh),
-                    jax.device_put(np.int32(state.step), self._rep_sh),
-                    jax.device_put(np.int32(n_valid), self._rep_sh))
+                args = (state.params, state.tstate, x, y,
+                        jax.device_put(sub, self._rep_sh),
+                        jax.device_put(np.int32(state.step), self._rep_sh),
+                        jax.device_put(np.int32(n_valid), self._rep_sh))
             else:
                 keys = jax.device_put(jax.random.split(sub, self.n_dp),
                                       self._batch_sh)
@@ -518,12 +520,19 @@ class DataParallelTrainer:
                     self._batch_sh)
                 nv = jax.device_put(
                     np.full((self.n_dp,), n_valid, np.int32), self._batch_sh)
-                params, tstate, loss = step_fn(
-                    state.params, state.tstate, x, y, keys, iters, nv)
-                if (state.step + 1) % self.average_every == 0:
-                    params = self._avg_fn(params)
-                    if obs:
-                        METRICS.increment("train_step.periodic_average")
+                args = (state.params, state.tstate, x, y, keys, iters, nv)
+            if first and obs:
+                # XLA cost per dispatch for this bucket (lower() reads
+                # avals only — safe before the donating call); feeds the
+                # live train.mfu gauge at every resolution fence
+                self._step_cost = COSTS.capture(
+                    f"train_step.b{bucket}", step_fn, *args)
+            params, tstate, loss = step_fn(*args)
+            if self.router != "iterative_reduce" \
+                    and (state.step + 1) % self.average_every == 0:
+                params = self._avg_fn(params)
+                if obs:
+                    METRICS.increment("train_step.periodic_average")
         lazy = LazyLoss(loss)
         if obs:
             dt = time.perf_counter() - t0
@@ -569,6 +578,12 @@ class DataParallelTrainer:
                 # `train_step` no longer measure execution)
                 METRICS.observe_many(
                     "train_step.execute", [window / len(entries)] * len(entries))
+                # live MFU/MBU from the same cost_analysis() accounting
+                # bench reports: one dispatch's flops over the amortized
+                # per-step execution time
+                COSTS.publish_utilization(
+                    self._step_cost, window / len(entries),
+                    "train.mfu", "train.mbu")
         self._window_t0 = None
         if self._nan_guard:
             # divergence detection lives at the resolution point — the one

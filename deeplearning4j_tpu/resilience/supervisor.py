@@ -41,8 +41,13 @@ import threading
 import time
 from typing import Any, Callable
 
-from ..observability import METRICS, trace
+from ..observability import FLIGHTREC, METRICS, trace
 from .faults import FAULTS, DivergenceError, TrainingPreempted
+
+
+def _loss_tail(by_step: dict, n: int = 32) -> dict:
+    """The last ``n`` step-keyed losses (JSON-safe) for a flight bundle."""
+    return {int(s): float(by_step[s]) for s in sorted(by_step)[-n:]}
 
 
 @dataclasses.dataclass
@@ -199,6 +204,14 @@ class TrainingSupervisor:
                         rollbacks += 1
                         self.report.rollbacks += 1
                         METRICS.increment("resilience.rollbacks")
+                        # flight bundle BEFORE the rollback decision: the
+                        # rings still hold the spans/chaos fires leading up
+                        # to the NaN, and the loss tail is step-keyed
+                        FLIGHTREC.dump("divergence", extra={
+                            "step": int(e.step),
+                            "value": repr(getattr(e, "value", None)),
+                            "rollbacks": rollbacks,
+                            "losses_tail": _loss_tail(by_step)})
                         if rollbacks > self.max_rollbacks:
                             METRICS.increment("resilience.gave_up")
                             raise
@@ -211,11 +224,15 @@ class TrainingSupervisor:
                             extra_skip += window
                             self.report.skipped_steps += window
                         continue
-                    except self.policy.retry_on:
+                    except self.policy.retry_on as e:
                         trainer.abort()
                         streak += 1
                         self.report.retries += 1
                         METRICS.increment("resilience.retries")
+                        FLIGHTREC.dump("supervisor_retry", extra={
+                            "error": repr(e),
+                            "streak": streak,
+                            "losses_tail": _loss_tail(by_step)})
                         if streak >= self.policy.max_attempts:
                             METRICS.increment("resilience.gave_up")
                             raise

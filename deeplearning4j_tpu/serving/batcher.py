@@ -23,7 +23,7 @@ import time
 from collections import deque
 from typing import Any
 
-from ..observability import METRICS
+from ..observability import FLIGHTREC, METRICS
 from ..resilience.faults import FAULTS
 
 
@@ -71,6 +71,12 @@ class GenerateRequest:
     deadline_s: float | None = None  # absolute time.monotonic() deadline
     id: int = dataclasses.field(default_factory=lambda: next(_REQ_IDS))
     submitted_s: float = 0.0        # stamped by RequestQueue.submit
+    # distributed-trace identity (stamped by InferenceEngine.submit when
+    # observability is on; empty strings otherwise — zero extra allocation)
+    trace_id: str = ""              # W3C trace id for the whole request
+    parent_span_id: str = ""        # inbound traceparent's span (if any)
+    root_span_id: str = ""          # the serving.request span's own id
+    submitted_perf: float = 0.0     # perf_counter twin of submitted_s (spans)
 
 
 @dataclasses.dataclass
@@ -159,10 +165,12 @@ class RequestQueue:
         with self._cv:
             if len(self._items) >= self.max_depth:
                 METRICS.increment("serving.rejected")
+                FLIGHTREC.note_429()
                 raise QueueFull(
                     f"request queue full ({self.max_depth} deep) — retry "
                     "with backoff")
             request.submitted_s = time.monotonic()
+            request.submitted_perf = time.perf_counter()
             pending = PendingResult(request)
             self._items.append(pending)
             METRICS.gauge("serving.queue.depth", len(self._items))

@@ -13,6 +13,8 @@ import json
 import urllib.error
 import urllib.request
 
+from ..observability import trace
+
 
 class ServingError(RuntimeError):
     """An HTTP error answer from the model server."""
@@ -32,9 +34,15 @@ class ServingClient:
     # ------------------------------------------------------------ transport
     def _request(self, path: str, payload: dict | None = None):
         data = json.dumps(payload).encode() if payload is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        # W3C trace propagation: when the caller is inside a span (or a
+        # bound context), the server joins that trace instead of minting
+        tp = trace.current_traceparent()
+        if tp is not None and data is not None:
+            headers["traceparent"] = tp
         req = urllib.request.Request(
             self.base + path, data=data, method="POST" if data else "GET",
-            headers={"Content-Type": "application/json"} if data else {})
+            headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 body = r.read()

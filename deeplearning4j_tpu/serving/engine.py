@@ -66,7 +66,8 @@ from ..models.transformer import (decode_step, decode_step_paged,
                                   gather_paged_kv, init_decode_cache,
                                   init_paged_cache, paged_flat_index,
                                   reset_cache_pages, reset_cache_slots)
-from ..observability import METRICS, trace
+from ..observability import COSTS, FLIGHTREC, METRICS, trace
+from ..observability.core import enabled as _obs_enabled
 from ..parallel.checkpoint import CheckpointManager
 from ..parallel.compile_cache import setup_compile_cache
 from ..resilience.faults import FAULTS
@@ -210,6 +211,9 @@ class InferenceEngine:
         self._thread: threading.Thread | None = None
         self._admitted = 0                           # guarded-by: self._lock
         self._completed = 0                          # guarded-by: self._lock
+        # XLA cost of one decode dispatch (captured at warmup) — feeds the
+        # live serving.decode_mfu gauge at every resolve fence
+        self._decode_cost = None                     # serve-thread-owned
 
     def _maybe_quantize(self, params):
         """The serving tree decode reads: unchanged by default; with
@@ -543,6 +547,17 @@ class InferenceEngine:
             eos_id=eos_id if eos_id is not None else self.cfg.default_eos_id,
             deadline_s=(time.monotonic() + deadline_ms / 1000.0
                         if deadline_ms else None))
+        if _obs_enabled():
+            # trace identity for the whole request: adopt the caller's
+            # context (HTTP traceparent installed via trace.bind, or an
+            # enclosing span), else mint — one trace_id spans queue wait,
+            # prefill, every decode segment, and emit
+            ctx = trace.current_trace_context()
+            if ctx is not None:
+                req.trace_id, req.parent_span_id = ctx
+            else:
+                req.trace_id = trace.new_trace_id()
+            req.root_span_id = trace.new_span_id()
         METRICS.increment("serving.requests")
         return self._queue.submit(req)
 
@@ -663,10 +678,18 @@ class InferenceEngine:
                         bt=self._state["bt"].at[0].set(
                             jnp.asarray(row, jnp.int32)))
                 dparams = self._draft_params if self.cfg.speculative else {}
+                # cost capture lowers with the concrete args BEFORE the
+                # donating call (lowering reads avals only, never buffers)
                 if self.cfg.speculative:
+                    self._decode_cost = COSTS.capture(
+                        "serving.decode_step", self._step_fn,
+                        self._params, dparams, self._state, jnp.int32(0))
                     state, _ = self._step_fn(self._params, dparams,
                                              self._state, jnp.int32(0))
                 else:
+                    self._decode_cost = COSTS.capture(
+                        "serving.decode_step", self._step_fn,
+                        self._params, self._state)
                     state, _ = self._step_fn(self._params, self._state)
                 self._step_compiled = True
                 for bucket in self._bucket_ladder():
@@ -772,6 +795,12 @@ class InferenceEngine:
             if not self._queue.claim(p):
                 continue
             req: GenerateRequest = p.request
+            if req.trace_id:
+                t_claim = time.perf_counter()
+                trace.record_span(
+                    "serving.queue_wait", req.submitted_perf,
+                    t_claim - req.submitted_perf, trace_id=req.trace_id,
+                    parent_id=req.root_span_id, request=req.id)
             with self._lock:
                 slot = self._free.pop()
                 params = self._params
@@ -815,12 +844,24 @@ class InferenceEngine:
                 prompt[:len(req.prompt)] = req.prompt
                 admit_fn = self._admit_for(bucket)
                 dparams = self._draft_params if self.cfg.speculative else {}
-                self._state = admit_fn(
-                    params, dparams, self._state, jnp.asarray(prompt),
-                    jnp.int32(len(req.prompt)), jnp.int32(cached_len),
-                    jnp.int32(slot), jax.random.key(req.seed),
-                    jnp.float32(req.temperature),
-                    jnp.int32(req.max_new_tokens))
+                args = (params, dparams, self._state, jnp.asarray(prompt),
+                        jnp.int32(len(req.prompt)), jnp.int32(cached_len),
+                        jnp.int32(slot), jax.random.key(req.seed),
+                        jnp.float32(req.temperature),
+                        jnp.int32(req.max_new_tokens))
+                if _obs_enabled():
+                    # per-bucket prefill cost (signature-cached: lowers
+                    # once per bucket shape, then a dict hit per admit)
+                    COSTS.capture(f"serving.prefill.b{bucket}", admit_fn,
+                                  *args)
+                t_pre = time.perf_counter()
+                self._state = admit_fn(*args)
+                if req.trace_id:
+                    trace.record_span(
+                        "serving.prefill", t_pre,
+                        time.perf_counter() - t_pre, trace_id=req.trace_id,
+                        parent_id=req.root_span_id, request=req.id,
+                        bucket=bucket)
                 if self.cfg.prefix_cache:
                     # publish every full-page chain of this prompt —
                     # entries pin their pages with their own refcount.
@@ -850,6 +891,7 @@ class InferenceEngine:
                     self._free.append(slot)
                 if isinstance(e, PagePoolExhausted):
                     METRICS.increment("serving.page_pool_exhausted")
+                    FLIGHTREC.note_429()
                 else:
                     METRICS.increment("serving.engine.errors")
                 p._fail(e)
@@ -934,6 +976,12 @@ class InferenceEngine:
         seg_s = time.perf_counter() - t0
         n_steps = len(pending)
         METRICS.observe_many("serving.decode_step", [seg_s / n_steps] * n_steps)
+        if self._decode_cost is not None and n_steps:
+            # live utilization from the same cost_analysis() accounting
+            # bench reports: flops of one dispatch / measured per-step time
+            COSTS.publish_utilization(
+                self._decode_cost, seg_s / n_steps,
+                "serving.decode_mfu", "serving.decode_mbu")
         if self.cfg.speculative:
             # accepted-prefix length per dispatch per live slot (clipped
             # emissions at the limit count too — still useful signal)
@@ -947,6 +995,13 @@ class InferenceEngine:
         for s in list(self._slots):
             sl = self._slots[s]
             req: GenerateRequest = sl.pending.request
+            if req.trace_id:
+                # one span per live slot per segment: all slots share the
+                # wall-clock segment (they decode in the same dispatches)
+                trace.record_span(
+                    "serving.decode.segment", t0, seg_s,
+                    trace_id=req.trace_id, parent_id=req.root_span_id,
+                    request=req.id, slot=s, steps=n_steps)
             finish = None
             for t in em[:, s].reshape(-1):
                 t = int(t)
@@ -978,6 +1033,7 @@ class InferenceEngine:
         hits zero are wiped (an aliased prefix page stays live and
         intact for its other readers), and the block-table row parks on
         the trash page."""
+        t_ev = time.perf_counter()
         with self._lock:
             sl = self._slots.pop(s)
             pages = self._slot_pages.pop(s, [])
@@ -1008,6 +1064,20 @@ class InferenceEngine:
             latency_s=now - req.submitted_s,
             ttft_s=(sl.first_token_s - req.submitted_s
                     if sl.first_token_s is not None else None)))
+        if req.trace_id:
+            t_done = time.perf_counter()
+            trace.record_span(
+                "serving.emit", t_ev, t_done - t_ev, trace_id=req.trace_id,
+                parent_id=req.root_span_id, request=req.id, finish=finish)
+            # the request's root span: submit -> completion, parented to
+            # the inbound traceparent (if any) so the HTTP client span
+            # and the engine flame share one trace in Perfetto
+            trace.record_span(
+                "serving.request", req.submitted_perf,
+                t_done - req.submitted_perf, trace_id=req.trace_id,
+                parent_id=req.parent_span_id or None,
+                span_id=req.root_span_id, request=req.id,
+                tokens=len(sl.delivered), finish=finish)
 
     # ------------------------------------------------------------ hot reload
     def reload(self) -> int:

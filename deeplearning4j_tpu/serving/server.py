@@ -30,7 +30,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from ..observability import METRICS, MetricsRegistry
+from ..observability import METRICS, MetricsRegistry, trace
 from ..resilience.faults import InjectedFault
 from .batcher import ServingRejected
 
@@ -83,13 +83,19 @@ class ModelServer:
                         raise ValueError("body must be a JSON object")
                 except (ValueError, json.JSONDecodeError) as e:
                     return self._json(400, {"error": f"bad request body: {e}"})
+                # W3C trace propagation: a valid inbound traceparent binds
+                # the ambient trace context for this handler thread, so
+                # the engine's request spans join the caller's trace; a
+                # malformed/absent header means the engine mints fresh
+                ctx = trace.parse_traceparent(self.headers.get("traceparent"))
                 try:
-                    if self.path == "/v1/generate":
-                        return self._json(200, outer._generate(payload))
-                    if self.path == "/v1/score":
-                        return self._json(200, outer._score(payload))
-                    if self.path == "/v1/reload":
-                        return self._json(200, outer._reload())
+                    with trace.bind(*ctx) if ctx else trace.bind(None):
+                        if self.path == "/v1/generate":
+                            return self._json(200, outer._generate(payload))
+                        if self.path == "/v1/score":
+                            return self._json(200, outer._score(payload))
+                        if self.path == "/v1/reload":
+                            return self._json(200, outer._reload())
                     return self._json(404, {"error": f"no route {self.path}"})
                 except ServingRejected as e:
                     # backpressure IS the API: 429 queue-full, 504 deadline

@@ -91,14 +91,23 @@ def rng_np():
 
 
 @pytest.fixture(autouse=True)
-def _reset_observability():
-    """The metrics registry and tracer are process-global singletons —
-    wipe them (and restore the enable flag) after every test so counters
-    recorded by one test can't satisfy another's assertions."""
-    yield
+def _reset_observability(tmp_path):
+    """The metrics registry, tracer and flight recorder are process-global
+    singletons — wipe them (and restore the enable flag) after every test
+    so counters recorded by one test can't satisfy another's assertions.
+    The flight recorder's dump directory is pointed at the test's tmp dir
+    for the DURATION of the test, so supervisor/serving tests that trip a
+    dump never litter the repo working tree.  The cost-model cache is
+    deliberately NOT cleared: signature hits persist across tests exactly
+    as they do across steps in one process."""
     from deeplearning4j_tpu import observability as obs
 
+    old_dump_dir = obs.FLIGHTREC.dump_dir
+    obs.FLIGHTREC.dump_dir = tmp_path / "flightrec"
+    yield
     obs.enable()
     obs.METRICS.reset()
     obs.TRACER.clear()
     obs.TRACER.stop_stream()
+    obs.FLIGHTREC.clear()
+    obs.FLIGHTREC.dump_dir = old_dump_dir

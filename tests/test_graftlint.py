@@ -752,3 +752,85 @@ def test_cli_rules_filter_and_unknown_rule(tmp_path, capsys):
     capsys.readouterr()
     assert main([path, "--no-metrics", "--rules", "NOPE"]) == 2
     assert "unknown rule" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------- OB01
+
+OB01_BAD = """
+    import time
+    import jax
+
+    step = jax.jit(lambda p, x: p * x)
+
+    def decode(p, x):
+        t0 = time.perf_counter()
+        out = step(p, x)
+        return out, time.perf_counter() - t0
+"""
+
+OB01_GOOD = """
+    import time
+    import jax
+    from deeplearning4j_tpu.observability import METRICS
+
+    step = jax.jit(lambda p, x: p * x)
+
+    def decode(p, x):
+        t0 = time.perf_counter()
+        out = step(p, x)
+        METRICS.observe_time("serving.decode_step", time.perf_counter() - t0)
+        return out
+"""
+
+OB01_GOOD_RECORD_SPAN = """
+    import time
+    import jax
+    from deeplearning4j_tpu.observability import trace
+
+    step = jax.jit(lambda p, x: p * x)
+
+    def decode(p, x):
+        t0 = time.perf_counter()
+        out = step(p, x)
+        trace.record_span("serving.decode", t0, time.perf_counter() - t0)
+        return out
+"""
+
+
+def test_ob01_fires_on_raw_timing_of_dispatch_in_serving():
+    findings = lint(OB01_BAD, only="OB01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+    assert rules_hit(findings) == {"OB01"}
+
+
+def test_ob01_fires_in_parallel_tree_too():
+    findings = lint(OB01_BAD, only="OB01",
+                    path="deeplearning4j_tpu/parallel/snippet.py")
+    assert rules_hit(findings) == {"OB01"}
+
+
+def test_ob01_quiet_outside_serving_and_parallel():
+    assert not lint(OB01_BAD, only="OB01",
+                    path="deeplearning4j_tpu/models/snippet.py")
+
+
+def test_ob01_quiet_when_measurement_reaches_registry():
+    assert not lint(OB01_GOOD, only="OB01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+
+
+def test_ob01_quiet_when_measurement_reaches_tracer():
+    assert not lint(OB01_GOOD_RECORD_SPAN, only="OB01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+
+
+def test_ob01_quiet_on_clock_without_dispatch():
+    src = """
+        import time
+
+        def backoff(attempt):
+            t0 = time.monotonic()
+            return t0 + 2.0 ** attempt
+    """
+    assert not lint(src, only="OB01",
+                    path="deeplearning4j_tpu/serving/snippet.py")

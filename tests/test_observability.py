@@ -504,3 +504,157 @@ def test_observe_shim_still_exports_legacy_names():
     assert observe.MetricsRegistry is MetricsRegistry
     assert observe.StatusServer is StatusServer
     assert observe.StepTimer is StepTimer
+
+
+# --------------------------------------------------------------------------- trace identity (PR 10)
+
+def test_span_ids_mint_and_inherit():
+    tracer = Tracer()
+    with tracer.span("root") as r:
+        with tracer.span("child") as c:
+            assert c.trace_id == r.trace_id
+            assert c.parent_id == r.span_id
+            assert c.span_id != r.span_id
+    assert re.fullmatch(r"[0-9a-f]{32}", r.trace_id)
+    assert re.fullmatch(r"[0-9a-f]{16}", r.span_id)
+    events = {e["name"]: e["args"] for e in tracer.to_chrome_trace()["traceEvents"]}
+    assert events["child"]["trace_id"] == events["root"]["trace_id"]
+    assert events["child"]["parent_span_id"] == events["root"]["span_id"]
+    assert events["root"]["parent_span_id"] is None
+
+
+def test_traceparent_roundtrip_and_rejection():
+    tid, sid = trace.new_trace_id(), trace.new_span_id()
+    header = f"00-{tid}-{sid}-01"
+    assert trace.parse_traceparent(header) == (tid, sid)
+    assert trace.parse_traceparent(header.upper()) == (tid, sid)
+    for bad in (None, "", "garbage", "00-short-ids-01",
+                f"00-{'0' * 32}-{sid}-01",        # all-zero trace id
+                f"00-{tid}-{'0' * 16}-01",        # all-zero span id
+                f"zz-{tid}-{sid}-01",             # non-hex version
+                f"00-{tid}-{sid}"):               # missing flags
+        assert trace.parse_traceparent(bad) is None, bad
+
+
+def test_bind_adopts_remote_context():
+    """A span opened inside ``bind`` joins the bound trace — the server
+    side of traceparent propagation."""
+    tid, parent = trace.new_trace_id(), trace.new_span_id()
+    with trace.bind(tid, parent):
+        assert trace.current_traceparent() == f"00-{tid}-{parent}-01"
+        with trace.span("handler") as sp:
+            assert sp.trace_id == tid
+            assert sp.parent_id == parent
+    assert trace.current_trace_context() is None
+
+
+def test_current_traceparent_reflects_open_span():
+    with trace.span("outer") as sp:
+        tp = trace.current_traceparent()
+        assert tp == f"00-{sp.trace_id}-{sp.span_id}-01"
+    assert trace.current_traceparent() is None
+
+
+def test_record_span_explicit_times():
+    import time as _time
+
+    tracer = Tracer()
+    tid = trace.new_trace_id()
+    t0 = _time.perf_counter()
+    sid = tracer.record_span("explicit", t0, 0.25, trace_id=tid,
+                             parent_id="a" * 16, request=7)
+    (ev,) = tracer.to_chrome_trace()["traceEvents"]
+    assert ev["args"]["trace_id"] == tid
+    assert ev["args"]["span_id"] == sid
+    assert ev["args"]["parent_span_id"] == "a" * 16
+    assert ev["args"]["request"] == 7
+    assert abs(ev["dur"] - 0.25e6) < 1.0      # 250ms in µs
+    assert ev["ts"] >= 0
+
+
+def test_dropped_events_counted_and_stamped():
+    """Satellite 1: overrunning the bounded ring is observable — a
+    counter increments and the export carries the drop count."""
+    tracer = Tracer(max_events=16)
+    before = METRICS.snapshot()["counters"].get("trace.dropped_events", 0)
+    for _ in range(64):
+        with tracer.span("s"):
+            pass
+    doc = tracer.to_chrome_trace()
+    assert len(doc["traceEvents"]) == 16
+    assert doc["metadata"]["dropped"] == 48
+    after = METRICS.snapshot()["counters"].get("trace.dropped_events", 0)
+    assert after - before == 48
+    tracer.clear()
+    assert tracer.to_chrome_trace()["metadata"]["dropped"] == 0
+
+
+def test_chrome_trace_validity_and_nesting(tmp_path):
+    """Satellite 3: exported traces parse, every ts/dur is non-negative,
+    and expanding complete events to B/E pairs yields a properly nested
+    per-thread stack (no partial overlap from the ``with`` API)."""
+    tracer = Tracer()
+    with tracer.span("a"):
+        with tracer.span("b"):
+            with tracer.span("c"):
+                pass
+        with tracer.span("d"):
+            pass
+    doc = json.loads(tracer.save_chrome_trace(tmp_path / "t.json").read_text())
+    events = doc["traceEvents"]
+    assert len(events) == 4
+    be = []
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        be.append((ev["ts"], "B", ev["name"]))
+        be.append((ev["ts"] + ev["dur"], "E", ev["name"]))
+    # sort by time; at equal timestamps E comes before B (adjacent spans)
+    be.sort(key=lambda t: (t[0], t[1] == "B"))
+    stack = []
+    for _, ph, name in be:
+        if ph == "B":
+            stack.append(name)
+        else:
+            assert stack and stack[-1] == name, \
+                f"unbalanced B/E pairs: closing {name} with stack {stack}"
+            stack.pop()
+    assert stack == []
+
+
+@pytest.mark.lockguard
+def test_registry_and_tracer_survive_serving_style_contention():
+    """Satellite 3: hammer observe_time/increment/to_prometheus (and the
+    listener fan-out to the flight recorder) from concurrent threads the
+    way the serving engine + HTTP scrape threads do, under instrumented
+    locks — no deadlock, no lost-update assertion, no exception."""
+    from deeplearning4j_tpu.observability import FLIGHTREC
+
+    reg = METRICS           # the real singleton: listener fan-out included
+    errors = []
+    n_threads, n_iter = 6, 300
+
+    def worker(i):
+        try:
+            for k in range(n_iter):
+                reg.increment("hammer.count")
+                reg.observe_time("hammer.lat", 0.001 * (k % 7 + 1))
+                reg.gauge("hammer.gauge", float(k))
+                if k % 50 == 0:
+                    reg.to_prometheus()
+                    reg.snapshot()
+        except Exception as e:          # pragma: no cover - failure path
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors
+    snap = METRICS.snapshot()
+    assert snap["counters"]["hammer.count"] == n_threads * n_iter
+    assert snap["timers"]["hammer.lat"]["count"] == n_threads * n_iter
+    # the passive listener saw the traffic too (bounded ring, no growth)
+    assert len(FLIGHTREC.metric_events) <= FLIGHTREC.metric_events.maxlen

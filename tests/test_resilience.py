@@ -12,6 +12,7 @@ with batch-window skip, preemption resume, job retry/quarantine, run
 timeouts, and the FileModelSaver tmp-file race.
 """
 
+import json
 import threading
 import time
 import warnings
@@ -544,3 +545,95 @@ def test_chaos_smoke_fixed_seed():
     assert result["loss_parity"]
     assert result["final_step"] == result["ref_step"]
     assert result["faults_injected"]
+
+
+# ------------------------------------------------------------ flight recorder
+
+def test_injected_step_fault_dumps_flight_bundle(tmp_path):
+    """PR 10 acceptance: killing a supervised run via an injected
+    ``train.step`` fault writes a flight-recorder bundle holding the
+    fault's chaos site, the last spans, and the step-keyed loss tail."""
+    from deeplearning4j_tpu.observability import FLIGHTREC
+
+    params, loss_fn, x, y = _toy_problem()
+    data = _batches(x, y)
+    FLIGHTREC.clear()
+    FLIGHTREC.dump_dir = tmp_path / "flight"
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    with inject_faults(FaultSpec("train.step", at_step=5), seed=3):
+        sup = TrainingSupervisor(
+            mgr, RetryPolicy(max_attempts=4, backoff_base_s=0.01))
+        t = _new_trainer(loss_fn)
+        state, losses = sup.fit(t, params, data, epochs=1,
+                                checkpoint_every=2)
+
+    assert state.step == 8                     # recovered and finished
+    bundles = sorted((tmp_path / "flight").glob(
+        "flightrec-supervisor_retry-*.json"))
+    assert bundles, "supervisor retry produced no flight bundle"
+    bundle = json.loads(bundles[0].read_text())
+    # the chaos fire that killed the attempt is on record
+    assert any(f["site"] == "train.step" for f in bundle["faults"])
+    assert "injected fault" in bundle["extra"]["error"]
+    # recent spans from before the crash survived it
+    assert bundle["spans"], "span ring empty at dump time"
+    span_names = {s["name"] for s in bundle["spans"]}
+    assert any(n.startswith(("train", "checkpoint", "resilience"))
+               for n in span_names), span_names
+    # the loss tail is keyed by STEP (string keys after JSON round-trip)
+    tail = bundle["extra"]["losses_tail"]
+    assert all(k.isdigit() for k in tail)
+    assert all(np.isfinite(v) for v in tail.values())
+    # the full metrics snapshot rides along with the fault counter in it
+    assert bundle["metrics"]["counters"]["faults.injected.train.step"] == 1
+
+
+def test_divergence_dump_carries_step_and_loss_tail(tmp_path):
+    """The NaN-guard path dumps too, with the diverging step identified."""
+    from deeplearning4j_tpu.observability import FLIGHTREC
+
+    params, loss_fn, x, y = _toy_problem()
+    y = np.array(y)
+    y[4 * 8:5 * 8] = np.nan                  # batch index 4 -> step 5
+    data = _batches(x, jnp.asarray(y))
+    FLIGHTREC.clear()
+    FLIGHTREC.dump_dir = tmp_path / "flight"
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    sup = TrainingSupervisor(mgr, RetryPolicy(max_attempts=3,
+                                              backoff_base_s=0.01))
+    t = _new_trainer(loss_fn)
+    sup.fit(t, params, data, epochs=1, checkpoint_every=1)
+
+    bundles = sorted((tmp_path / "flight").glob(
+        "flightrec-divergence-*.json"))
+    assert len(bundles) == 1
+    bundle = json.loads(bundles[0].read_text())
+    assert bundle["extra"]["step"] == 5
+    assert bundle["extra"]["rollbacks"] == 1
+    assert bundle["metrics"]["counters"]["resilience.nan_detected"] == 1
+
+
+def test_explicit_corrupt_restore_dumps_bundle(tmp_path):
+    """CheckpointCorruptError (explicit-step restore) triggers a dump."""
+    from deeplearning4j_tpu.observability import FLIGHTREC
+
+    FLIGHTREC.clear()
+    FLIGHTREC.dump_dir = tmp_path / "flight"
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=5)
+    mgr.save(1, {"w": jnp.zeros(3)})
+    # flip bits under the checksums
+    payload = tmp_path / "ckpt" / "ckpt_0000000001" / "params.npz"
+    raw = bytearray(payload.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    payload.write_bytes(bytes(raw))
+
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore({"w": jnp.zeros(3)}, step=1)
+    bundles = list((tmp_path / "flight").glob(
+        "flightrec-checkpoint_corrupt-*.json"))
+    assert len(bundles) == 1
+    bundle = json.loads(bundles[0].read_text())
+    assert bundle["extra"]["step"] == 1
+    assert str(tmp_path / "ckpt") in bundle["extra"]["directory"]

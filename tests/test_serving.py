@@ -507,3 +507,94 @@ def test_stats_and_stop_race_free_during_traffic(lm):
             t.join(10.0)
     assert errors == []
     assert engine.stats()["completed"] == 6
+
+
+# ---------------------------------------------------------- request tracing
+
+def test_request_trace_chain_over_http(lm):
+    """PR 10 acceptance: a traced client call propagates its W3C
+    traceparent over HTTP, and the engine's queue_wait -> prefill ->
+    decode -> emit spans all share the CLIENT's trace id, parented under
+    one serving.request root."""
+    from deeplearning4j_tpu.observability import TRACER, trace
+
+    model, params = lm
+    TRACER.clear()
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    with engine, ModelServer(engine=engine) as server:
+        client = ServingClient(port=server.port)
+        with trace.span("client.generate") as sp:
+            out = client.generate([5, 1, 4], max_new_tokens=6)
+        client_trace = sp.trace_id
+        client_span = sp.span_id
+    assert len(out["tokens"]) == 6
+
+    events = [e for e in TRACER.to_chrome_trace()["traceEvents"]
+              if (e["args"].get("trace_id") == client_trace
+                  and e["name"].startswith("serving."))]
+    names = {e["name"] for e in events}
+    assert {"serving.request", "serving.queue_wait", "serving.prefill",
+            "serving.decode.segment", "serving.emit"} <= names
+
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    (root,) = by_name["serving.request"]
+    # the server-side root is a CHILD of the client span (joined, not minted)
+    assert root["args"]["parent_span_id"] == client_span
+    for name in ("serving.queue_wait", "serving.prefill",
+                 "serving.decode.segment", "serving.emit"):
+        for e in by_name[name]:
+            assert e["args"]["parent_span_id"] == root["args"]["span_id"]
+    # phases sit inside the root on the timeline (small tolerance: span
+    # ends are stamped on the serve thread after the phase boundary)
+    t0, t1 = root["ts"], root["ts"] + root["dur"]
+    for name in ("serving.queue_wait", "serving.prefill", "serving.emit"):
+        for e in by_name[name]:
+            assert e["ts"] >= t0 - 1e3
+            assert e["ts"] + e["dur"] <= t1 + 1e3
+
+
+def test_untraced_request_mints_trace_and_decode_mfu_lands(lm):
+    """Without a caller span the engine mints a fresh trace id at
+    admission; the decode loop publishes serving.decode_mfu either way."""
+    from deeplearning4j_tpu.observability import METRICS, TRACER
+
+    model, params = lm
+    TRACER.clear()
+    engine = InferenceEngine(model, params=params,
+                             cfg=ServingConfig(slots=2, resolve_every=2))
+    with engine:
+        engine.generate([3, 1, 4], max_new_tokens=5)
+    roots = [e for e in TRACER.to_chrome_trace()["traceEvents"]
+             if e["name"] == "serving.request"]
+    assert len(roots) == 1
+    tid = roots[0]["args"]["trace_id"]
+    assert tid and len(tid) == 32 and int(tid, 16) != 0
+    gauges = METRICS.snapshot()["gauges"]
+    assert gauges["serving.decode_mfu"] > 0
+    assert np.isfinite(gauges["serving.decode_mfu"])
+
+
+def test_disabled_observability_serves_without_spans(lm):
+    """DL4J_TPU_OBS=0 contract: with the layer disabled the engine still
+    serves, and records no spans, no cost capture, no MFU gauges."""
+    from deeplearning4j_tpu import observability as obs
+    from deeplearning4j_tpu.observability import METRICS, TRACER
+
+    model, params = lm
+    TRACER.clear()
+    METRICS.reset()
+    obs.disable()
+    try:
+        engine = InferenceEngine(model, params=params,
+                                 cfg=ServingConfig(slots=2, resolve_every=2))
+        with engine:
+            out = engine.generate([5, 1, 4], max_new_tokens=4)
+    finally:
+        obs.enable()
+    assert len(out.tokens) == 4
+    assert TRACER.to_chrome_trace()["traceEvents"] == []
+    assert "serving.decode_mfu" not in METRICS.snapshot()["gauges"]
+    assert engine._decode_cost is None

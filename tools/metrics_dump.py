@@ -85,11 +85,53 @@ def render_state_memory(snap: dict) -> str | None:
                  ("device", "params", "opt_state"))
 
 
+def render_serving(snap: dict) -> str | None:
+    """Paged-KV / prefix-cache / speculative serving gauges (PR-9), plus
+    the speculative accepted-prefix histogram.  Returns None when the job
+    published none of them (non-serving jobs, dense engines)."""
+    gauges = snap.get("gauges", {})
+    rows = []
+    if "serving.kv_pages_in_use" in gauges:
+        rows.append(("kv_pages_in_use", f"{gauges['serving.kv_pages_in_use']:.0f}"))
+    if "serving.prefix_hit_rate" in gauges:
+        rows.append(("prefix_hit_rate",
+                     f"{gauges['serving.prefix_hit_rate'] * 100:.1f}%"))
+    if "serving.kv_bytes_per_slot" in gauges:
+        rows.append(("kv_bytes_per_slot",
+                     _fmt_bytes(gauges["serving.kv_bytes_per_slot"])))
+    accept = snap.get("timers", {}).get("serving.spec_accept_len")
+    if accept:
+        rows.append(("spec_accept_len(mean)",
+                     f"{accept['mean_s']:.2f} tok over {accept['count']} windows"))
+    if not rows:
+        return None
+    return _rows("serving (paged KV / prefix cache / speculative)", rows,
+                 ("metric", "value"))
+
+
+def render_utilization(snap: dict) -> str | None:
+    """MFU / memory-bandwidth gauges from the analytic cost model
+    (``observability.cost``): published by the trainer, the decode loop
+    and bench.py from the same ``cost_analysis()``-derived FLOPs."""
+    gauges = snap.get("gauges", {})
+    rows = [(name, f"{gauges[name] * 100:.2f}%")
+            for name in ("train.mfu", "train.mbu",
+                         "serving.decode_mfu", "serving.decode_mbu")
+            if name in gauges]
+    if not rows:
+        return None
+    return _rows("utilization (analytic cost model)", rows,
+                 ("gauge", "value"))
+
+
 def render_metrics(snap: dict) -> str:
     parts = []
     state_mem = render_state_memory(snap)
     if state_mem is not None:
         parts.append(state_mem)
+    for section in (render_serving(snap), render_utilization(snap)):
+        if section is not None:
+            parts.append(section)
     parts.append(_rows(
         "counters", sorted(snap.get("counters", {}).items()),
         ("name", "value")))

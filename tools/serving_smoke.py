@@ -12,11 +12,19 @@ histograms land.
 
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
                                   [--lockguard] [--prefix-workload]
+                                  [--trace-out trace.json]
 
 ``--lockguard`` runs the whole smoke with instrumented threading locks
 (analysis/lockguard.py): lock-order inversions and Eraser-style unguarded
 shared writes observed anywhere in the engine/queue/HTTP path fail the
 run, and the violation count lands in the JSON result.
+
+``--trace-out PATH`` saves a merged Chrome trace of the run (each client
+call opens a ``client.generate`` span whose trace id rides the W3C
+``traceparent`` header, so server-side ``serving.*`` spans join it) and
+FAILS unless every completed request's trace carries the full
+queue_wait -> prefill -> decode -> emit chain under one trace id.  Feed
+the file to ``tools/trace_report.py`` for the per-request TTFT breakdown.
 
 ``--prefix-workload`` switches to the paged/prefix-cache smoke: a
 Zipf-skewed population of shared system prompts (the multi-tenant
@@ -41,20 +49,22 @@ import threading
 
 
 def run(requests: int = 32, threads: int = 4, seed: int = 0,
-        lockguard: bool = False) -> dict:
+        lockguard: bool = False, trace_out: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu import observability
     from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                        TransformerLM)
-    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.observability import METRICS, TRACER, trace
     from deeplearning4j_tpu.serving import (BatchScorer, InferenceEngine,
                                             ModelServer, ServingClient,
                                             ServingConfig, ServingError)
 
     observability.enable()
     METRICS.reset()
+    if trace_out is not None:
+        TRACER.clear()
 
     guard = None
     if lockguard:
@@ -89,12 +99,20 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0,
                       seed=rng.randrange(1 << 20))
                  for _ in range(requests)]
 
+        completed_traces: list[str] = []
+
         def worker(mine):
             for plan in mine:
                 try:
-                    out = client.generate(**plan)
+                    # a client-side span per call: its trace id rides the
+                    # traceparent header, so the server JOINS this trace
+                    # instead of minting its own
+                    with trace.span("client.generate") as sp:
+                        out = client.generate(**plan)
                     with lock:
                         statuses.append(200)
+                        if getattr(sp, "trace_id", ""):
+                            completed_traces.append(sp.trace_id)
                     if len(out["tokens"]) > plan["max_new_tokens"]:
                         with lock:
                             failures.append(f"overlong answer for {plan}")
@@ -123,6 +141,44 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0,
         guard.emit_metrics()
         for v in guard.violations():
             failures.append(str(v))
+
+    trace_summary = None
+    if trace_out is not None:
+        # engine + server + client all live in this process, so the
+        # tracer already holds every side's spans; write, then round-trip
+        # through the merger so the output is the same shape a multi-
+        # process merge would produce
+        from tools.trace_report import merge
+        TRACER.save_chrome_trace(trace_out)
+        merged = merge([trace_out])
+        with open(trace_out, "w") as f:
+            json.dump(merged, f)
+        events = merged["traceEvents"]
+        by_trace: dict[str, set] = {}
+        tokens_by_trace: dict[str, int] = {}
+        for ev in events:
+            tid = (ev.get("args") or {}).get("trace_id")
+            if not tid:
+                continue
+            by_trace.setdefault(tid, set()).add(ev["name"])
+            if ev["name"] == "serving.request":
+                tokens_by_trace[tid] = int((ev.get("args") or {}).get("tokens") or 0)
+        need = {"serving.request", "serving.queue_wait",
+                "serving.prefill", "serving.emit"}
+        for tid in completed_traces:
+            names = by_trace.get(tid, set())
+            missing_spans = need - names
+            # a 1-token answer legitimately finishes inside prefill —
+            # decode segments are only required when decode actually ran
+            if tokens_by_trace.get(tid, 0) > 1 and \
+                    "serving.decode.segment" not in names:
+                missing_spans.add("serving.decode.segment")
+            if missing_spans:
+                failures.append(
+                    f"trace {tid[:12]} missing spans {sorted(missing_spans)}")
+        trace_summary = {"path": trace_out, "events": len(events),
+                         "requests_traced": len(completed_traces),
+                         "dropped": merged["metadata"]["dropped"]}
 
     snap = METRICS.snapshot()
     timers, gauges = snap["timers"], snap["gauges"]
@@ -154,6 +210,8 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0,
         "missing_histograms": missing,
         "failures": failures[:5],
     }
+    if trace_summary is not None:
+        result["trace"] = trace_summary
     if guard is not None:
         result["lockguard_violations"] = len(guard.violations())
     assert not failures, failures[:5]
@@ -347,7 +405,8 @@ def main(argv: list[str]) -> int:
         out = run(requests=arg("--requests", 32),
                   threads=arg("--threads", 4),
                   seed=arg("--seed", 0),
-                  lockguard="--lockguard" in argv)
+                  lockguard="--lockguard" in argv,
+                  trace_out=arg("--trace-out", None, str))
     print(json.dumps(out))
     return 0
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Merge per-process trace exports into one Chrome trace + TTFT report.
+
+Usage:
+    python tools/trace_report.py trace1.json worker2.jsonl ...
+                                 [--out merged.json] [--limit 20]
+
+Inputs may be Chrome trace JSON objects (``{"traceEvents": [...]}`` as
+written by ``Tracer.save_chrome_trace``) or JSONL event streams (one event
+per line, as written by ``Tracer.export_jsonl`` / ``stream_jsonl``).  All
+events share one ``time.perf_counter()``-anchored µs clock per host, so
+merging exports from co-located processes (trainer + server + workers)
+yields a single Perfetto-loadable flame; ``--out`` writes that merged
+trace.
+
+The report groups serving spans by ``args.trace_id`` (the W3C trace id
+minted at admission or propagated via ``traceparent``) and prints, per
+request, the critical-path breakdown the engine records:
+
+    queue_wait | prefill | decode (sum of segments) | emit | TTFT | total
+
+TTFT here is time from submission to the end of prefill — the first
+token exists when prefill's last dispatch resolves.  Requests missing a
+``serving.request`` root (still in flight at export time) are skipped.
+
+Exits nonzero when no input file yields any events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def load_events(path: str | Path) -> tuple[list[dict], int]:
+    """Events + dropped-count from one export (Chrome JSON or JSONL)."""
+    text = Path(path).read_text()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None                   # multiple lines -> JSONL
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        dropped = int((obj.get("metadata") or {}).get("dropped", 0))
+        return obj["traceEvents"], dropped
+    if isinstance(obj, dict):
+        return [obj], 0              # a single-event JSONL file
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn tail line from a crashed streamer
+    return events, 0
+
+
+def merge(paths: list[str]) -> dict:
+    """One Chrome trace object from many exports; ``dropped`` summed."""
+    all_events: list[dict] = []
+    dropped = 0
+    for p in paths:
+        evs, d = load_events(p)
+        all_events.extend(evs)
+        dropped += d
+    all_events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": all_events,
+            "displayTimeUnit": "ms",
+            "metadata": {"dropped": dropped, "sources": list(paths)}}
+
+
+def _by_request(events: list[dict]) -> dict[str, dict[str, list[dict]]]:
+    """trace_id -> span name -> events, for serving.* spans only."""
+    out: dict[str, dict[str, list[dict]]] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or not str(ev.get("name", "")).startswith("serving."):
+            continue
+        tid = (ev.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        out.setdefault(tid, {}).setdefault(ev["name"], []).append(ev)
+    return out
+
+
+def request_breakdowns(events: list[dict]) -> list[dict]:
+    """Per-request phase durations (ms), newest first, roots required."""
+    rows = []
+    for trace_id, spans in _by_request(events).items():
+        roots = spans.get("serving.request")
+        if not roots:
+            continue  # request still in flight when the trace was cut
+        root = roots[0]
+
+        def total_ms(name: str) -> float:
+            return sum(e.get("dur", 0.0) for e in spans.get(name, ())) / 1e3
+
+        prefills = spans.get("serving.prefill", ())
+        ttft_ms = None
+        if prefills:
+            p = prefills[0]
+            ttft_ms = (p["ts"] + p.get("dur", 0.0) - root["ts"]) / 1e3
+        rows.append({
+            "trace_id": trace_id,
+            "start_ts_us": root["ts"],
+            "queue_wait_ms": total_ms("serving.queue_wait"),
+            "prefill_ms": total_ms("serving.prefill"),
+            "decode_ms": total_ms("serving.decode.segment"),
+            "decode_segments": len(spans.get("serving.decode.segment", ())),
+            "emit_ms": total_ms("serving.emit"),
+            "ttft_ms": ttft_ms,
+            "total_ms": root.get("dur", 0.0) / 1e3,
+            "tokens": (root.get("args") or {}).get("tokens"),
+        })
+    rows.sort(key=lambda r: r["start_ts_us"])
+    return rows
+
+
+def render(rows: list[dict], limit: int) -> str:
+    if not rows:
+        return "no completed serving requests in the trace"
+    shown = rows[-limit:] if limit else rows
+
+    def ms(v):
+        return "-" if v is None else f"{v:.2f}"
+
+    headers = ("trace_id", "queue", "prefill", "decode", "segs",
+               "emit", "ttft", "total", "tokens")
+    cells = [(r["trace_id"][:12], ms(r["queue_wait_ms"]), ms(r["prefill_ms"]),
+              ms(r["decode_ms"]), str(r["decode_segments"]), ms(r["emit_ms"]),
+              ms(r["ttft_ms"]), ms(r["total_ms"]), str(r["tokens"] or "-"))
+             for r in shown]
+    widths = [max(len(h), *(len(c[i]) for c in cells))
+              for i, h in enumerate(headers)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [f"per-request critical path (ms; {len(rows)} completed, "
+             f"showing {len(shown)})",
+             fmt.format(*headers),
+             "-" * (sum(widths) + 2 * (len(widths) - 1))]
+    lines.extend(fmt.format(*c) for c in cells)
+    ttfts = sorted(r["ttft_ms"] for r in rows if r["ttft_ms"] is not None)
+    if ttfts:
+        lines.append(
+            f"TTFT p50={ttfts[len(ttfts) // 2]:.2f}ms "
+            f"p99={ttfts[min(len(ttfts) - 1, (99 * len(ttfts)) // 100)]:.2f}ms "
+            f"over {len(ttfts)} requests")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("traces", nargs="+",
+                    help="Chrome-trace JSON or JSONL export files")
+    ap.add_argument("--out", help="write the merged Chrome trace here")
+    ap.add_argument("--limit", type=int, default=20,
+                    help="max requests to print (0 = all)")
+    args = ap.parse_args(argv)
+
+    merged = merge(args.traces)
+    if not merged["traceEvents"]:
+        print("no events found in any input", file=sys.stderr)
+        return 1
+    if merged["metadata"]["dropped"]:
+        print(f"warning: {merged['metadata']['dropped']} events were dropped "
+              "by bounded ring buffers before export", file=sys.stderr)
+    if args.out:
+        Path(args.out).write_text(json.dumps(merged))
+        print(f"merged {len(merged['traceEvents'])} events from "
+              f"{len(args.traces)} file(s) -> {args.out}")
+    print(render(request_breakdowns(merged["traceEvents"]), args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
