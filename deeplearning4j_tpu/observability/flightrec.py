@@ -34,6 +34,7 @@ _SPAN_RING = 256
 _METRIC_RING = 512
 _FAULT_RING = 64
 _429_RING = 64
+_SPILL_RING = 64
 
 
 class FlightRecorder:
@@ -50,6 +51,11 @@ class FlightRecorder:
         self.burst_n = 8            # 429s ...
         self.burst_window_s = 2.0   # ... within this window -> dump
         self.burst_cooldown_s = 30.0
+        self._spills: deque[tuple[float, str]] = deque(maxlen=_SPILL_RING)
+        self._last_spill_dump = 0.0
+        self.spill_burst_n = 8          # spillovers ...
+        self.spill_window_s = 2.0       # ... within this window -> dump
+        self.spill_cooldown_s = 30.0
         self.dump_dir = Path(
             dump_dir if dump_dir is not None
             else os.environ.get("DL4J_TPU_FLIGHTREC_DIR", "flightrec"))
@@ -103,6 +109,33 @@ class FlightRecorder:
                                     "window_s": self.burst_window_s})
         return None
 
+    def note_spillover(self, replica: str) -> Path | None:
+        """Record one router spillover (a request shed off its affinity
+        replica).  A burst — ``spill_burst_n`` within ``spill_window_s``
+        — means a replica is effectively unavailable while still admitting
+        probes, so dump a bundle naming the replicas that shed, rate-
+        limited like :meth:`note_429`."""
+        if not core.enabled():
+            return None
+        now = time.monotonic()
+        with self._lock:
+            self._spills.append((now, replica))
+            burst = (len(self._spills) >= self.spill_burst_n
+                     and now - self._spills[-self.spill_burst_n][0]
+                     <= self.spill_window_s
+                     and now - self._last_spill_dump >= self.spill_cooldown_s)
+            if burst:
+                self._last_spill_dump = now
+                recent = [r for _, r in self._spills]
+            else:
+                recent = []
+        if burst:
+            return self.dump("router_spillover_burst",
+                             extra={"spillovers_in_window": self.spill_burst_n,
+                                    "window_s": self.spill_window_s,
+                                    "recent_replicas": recent})
+        return None
+
     # ------------------------------------------------------------- dump
     def dump(self, trigger: str, extra: dict[str, Any] | None = None
              ) -> Path | None:
@@ -138,6 +171,8 @@ class FlightRecorder:
             self.faults.clear()
             self._429s.clear()
             self._last_burst_dump = 0.0
+            self._spills.clear()
+            self._last_spill_dump = 0.0
 
 
 FLIGHTREC = FlightRecorder()

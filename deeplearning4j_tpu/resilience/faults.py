@@ -45,6 +45,17 @@ site                   effect when armed
                        ``FAULTS.check``) — accept length degrades but
                        emitted tokens stay target-drawn and token parity
                        holds (the rejection-sampling safety argument)
+``router.route``       :class:`TransientStepFault` raised before the router
+                       picks a replica (``PrefixRouter.generate``) — the
+                       router front end's 503 path, before any replica is
+                       touched
+``router.replica_down``  one replica behaves dead: its dispatches raise
+                       ``ReplicaUnavailable`` and its health probes fail
+                       (``PrefixRouter`` / ``ReplicaPool``, via
+                       ``FAULTS.check``).  ``kind`` names the target
+                       replica (default ``bitflip`` is treated as "any") —
+                       the chaos plan for breaker quarantine + ring
+                       re-admission
 =====================  =====================================================
 
 Arming:
@@ -134,6 +145,7 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "scaleout.perform": TransientStepFault,
     "serving.request": TransientStepFault,
     "serving.decode": TransientStepFault,
+    "router.route": TransientStepFault,
 }
 
 

@@ -14,24 +14,37 @@ from .batcher import (Completion, DeadlineExceeded, GenerateRequest,
                       RequestQueue, ScoreRequest, ServingRejected)
 from .client import ServingClient, ServingError
 from .engine import BatchScorer, InferenceEngine, ServingConfig
-from .paging import PagePool
+from .paging import PagePool, prefix_chain_keys
+from .router import (AllReplicasUnavailable, EngineReplica, HashRing,
+                     PrefixRouter, ProcessReplica, ReplicaPool,
+                     ReplicaUnavailable, RouterConfig, RouterServer)
 from .server import ModelServer
 
 __all__ = [
+    "AllReplicasUnavailable",
     "BatchScorer",
     "Completion",
     "DeadlineExceeded",
+    "EngineReplica",
     "GenerateRequest",
+    "HashRing",
     "InferenceEngine",
     "ModelServer",
     "PagePool",
     "PagePoolExhausted",
     "PendingResult",
+    "PrefixRouter",
+    "ProcessReplica",
     "QueueFull",
+    "ReplicaPool",
+    "ReplicaUnavailable",
     "RequestQueue",
+    "RouterConfig",
+    "RouterServer",
     "ScoreRequest",
     "ServingClient",
     "ServingConfig",
     "ServingError",
     "ServingRejected",
+    "prefix_chain_keys",
 ]

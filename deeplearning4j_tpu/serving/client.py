@@ -5,11 +5,22 @@ counterpart of the reference's REST client seams.  Rejections surface as
 :class:`ServingError` carrying the HTTP status, so callers can tell
 backpressure (429 — back off and retry) from bad requests (400) apart
 without parsing strings.
+
+Timeouts and retries: every call takes an optional per-call ``timeout_s``
+(falling back to the constructor default), and **idempotent GETs only**
+(``/healthz``, ``/metrics``, ``/metrics.prom``) are retried once with a
+short backoff on connection reset / refused / timeout.  POSTs are never
+retried here — a ``/v1/generate`` whose connection died may well have
+decoded to completion server-side, and replaying it is the router's
+decision (it knows spillover semantics), not the transport's.  The retry
+exists so a health prober polling a wedged replica gets a prompt, bounded
+failure instead of hanging a probe cycle.
 """
 
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -27,12 +38,16 @@ class ServingError(RuntimeError):
 
 class ServingClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, retries: int = 1,
+                 retry_backoff_s: float = 0.05):
         self.base = f"http://{host}:{port}"
         self.timeout_s = timeout_s
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
 
     # ------------------------------------------------------------ transport
-    def _request(self, path: str, payload: dict | None = None):
+    def _request(self, path: str, payload: dict | None = None,
+                 timeout_s: float | None = None):
         data = json.dumps(payload).encode() if payload is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
         # W3C trace propagation: when the caller is inside a span (or a
@@ -43,33 +58,46 @@ class ServingClient:
         req = urllib.request.Request(
             self.base + path, data=data, method="POST" if data else "GET",
             headers=headers)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                body = r.read()
-        except urllib.error.HTTPError as e:
-            raw = e.read()
+        deadline = timeout_s if timeout_s is not None else self.timeout_s
+        # idempotent GETs only: a dead POST may have executed server-side
+        attempts = 1 + (self.retries if data is None else 0)
+        for attempt in range(attempts):
             try:
-                detail = json.loads(raw).get("error", raw.decode("utf-8", "replace"))
-            except (ValueError, AttributeError):
-                detail = raw.decode("utf-8", "replace")
-            raise ServingError(e.code, detail) from e
-        return body
+                with urllib.request.urlopen(req, timeout=deadline) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                # the server answered — retrying an answered request is
+                # never the transport's call
+                raw = e.read()
+                try:
+                    detail = json.loads(raw).get(
+                        "error", raw.decode("utf-8", "replace"))
+                except (ValueError, AttributeError):
+                    detail = raw.decode("utf-8", "replace")
+                raise ServingError(e.code, detail) from e
+            except OSError:
+                # URLError (refused / reset), socket timeout, ECONNRESET
+                if attempt + 1 >= attempts:
+                    raise
+                time.sleep(self.retry_backoff_s * (2 ** attempt))
 
-    def _json(self, path: str, payload: dict | None = None) -> dict:
-        return json.loads(self._request(path, payload))
+    def _json(self, path: str, payload: dict | None = None,
+              timeout_s: float | None = None) -> dict:
+        return json.loads(self._request(path, payload, timeout_s=timeout_s))
 
     # ------------------------------------------------------------ API
     def generate(self, prompt, max_new_tokens: int = 16,
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None,
-                 deadline_ms: float | None = None) -> dict:
+                 deadline_ms: float | None = None,
+                 timeout_s: float | None = None) -> dict:
         body = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "seed": seed}
         if eos_id is not None:
             body["eos_id"] = eos_id
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
-        return self._json("/v1/generate", body)
+        return self._json("/v1/generate", body, timeout_s=timeout_s)
 
     def score(self, inputs) -> list:
         return self._json("/v1/score", {"inputs": [list(map(float, r))
@@ -78,11 +106,11 @@ class ServingClient:
     def reload(self) -> int:
         return self._json("/v1/reload", {})["step"]
 
-    def healthz(self) -> dict:
-        return self._json("/healthz")
+    def healthz(self, timeout_s: float | None = None) -> dict:
+        return self._json("/healthz", timeout_s=timeout_s)
 
-    def metrics(self) -> dict:
-        return self._json("/metrics")
+    def metrics(self, timeout_s: float | None = None) -> dict:
+        return self._json("/metrics", timeout_s=timeout_s)
 
-    def metrics_prom(self) -> str:
-        return self._request("/metrics.prom").decode()
+    def metrics_prom(self, timeout_s: float | None = None) -> str:
+        return self._request("/metrics.prom", timeout_s=timeout_s).decode()

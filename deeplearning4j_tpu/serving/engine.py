@@ -1136,6 +1136,9 @@ class InferenceEngine:
             out["kv_pages_in_use"] = self._pool.in_use()
             out["prefix_entries"] = self._pool.prefix_entries()
             out["prefix_hit_rate"] = self._pool.hit_rate()
+            hits, lookups = self._pool.hit_counts()
+            out["prefix_hits"] = hits
+            out["prefix_lookups"] = lookups
         return out
 
 

@@ -32,6 +32,29 @@ import threading
 from .batcher import PagePoolExhausted
 
 
+def prefix_chain_keys(tokens: list[int], usable: int,
+                      page_size: int) -> list[str]:
+    """Chained hashes for every full token page covering positions
+    ``< usable`` — key ``i`` addresses K/V for ``tokens[: (i+1)*ps]``
+    and, being chained, commits to the entire prefix, not just its own
+    page.
+
+    Module-level so the serving ROUTER can compute the same keys without
+    a pool: prefix-affinity routing consistent-hashes a request by this
+    chain, and any drift between the router's hash and the pool's would
+    silently destroy locality.  There is exactly one implementation.
+    """
+    keys: list[str] = []
+    h = b"kv-prefix-v1"
+    for k in range(1, usable // page_size + 1):
+        block = tokens[(k - 1) * page_size: k * page_size]
+        h = hashlib.blake2b(
+            h + (",".join(map(str, block))).encode(), digest_size=16,
+        ).digest()
+        keys.append(h.hex())
+    return keys
+
+
 class PrefixEntry:
     """One cached chain: the first ``len(pages)`` full token pages of
     some prompt, pinned (one refcount per page) until LRU-evicted."""
@@ -63,20 +86,8 @@ class PagePool:
 
     # -- content addressing ---------------------------------------------
     def chain_keys(self, tokens: list[int], usable: int) -> list[str]:
-        """Chained hashes for every full token page covering positions
-        ``< usable`` — key ``i`` addresses K/V for ``tokens[: (i+1)*ps]``
-        and, being chained, commits to the entire prefix, not just its
-        own page."""
-        ps = self.page_size
-        keys: list[str] = []
-        h = b"kv-prefix-v1"
-        for k in range(1, usable // ps + 1):
-            block = tokens[(k - 1) * ps: k * ps]
-            h = hashlib.blake2b(
-                h + (",".join(map(str, block))).encode(), digest_size=16,
-            ).digest()
-            keys.append(h.hex())
-        return keys
+        """See :func:`prefix_chain_keys` (shared with the router)."""
+        return prefix_chain_keys(tokens, usable, self.page_size)
 
     # -- acquire side ---------------------------------------------------
     def lookup_prefix(self, tokens: list[int], usable: int):
@@ -226,3 +237,10 @@ class PagePool:
     def hit_rate(self) -> float:
         with self._lock:
             return self._hits / self._lookups if self._lookups else 0.0
+
+    def hit_counts(self) -> tuple[int, int]:
+        """(hits, lookups) — absolute counts, so a router aggregating N
+        replicas can compute a pool-weighted hit rate (Σhits/Σlookups)
+        instead of averaging per-replica ratios."""
+        with self._lock:
+            return self._hits, self._lookups

@@ -1,0 +1,33 @@
+"""Multi-replica serving tier: a prefix-affinity consistent-hash router
+in front of N inference engines (DESIGN.md §19).
+
+:class:`PrefixRouter` hashes each request by its content-addressed
+prefix chain (the same chained page hash the KV :class:`~..paging.PagePool`
+uses) onto a virtual-node :class:`~.ring.HashRing` of replicas, so
+repeated system prompts land on the replica that already holds their KV
+pages.  :class:`~.replicas.ReplicaPool` supplies breaker-style health
+(quarantine on consecutive failures, re-admission on probe recovery),
+:class:`RouterServer` exposes the single-replica ``ModelServer`` HTTP
+surface unchanged, and replicas are either in-process engines
+(:class:`~.replicas.EngineReplica`) or spawned ``ModelServer`` processes
+(:class:`~.replicas.ProcessReplica`).
+"""
+
+from .replicas import (AllReplicasUnavailable, EngineReplica, ProcessReplica,
+                       Replica, ReplicaPool, ReplicaUnavailable)
+from .ring import HashRing
+from .router import PrefixRouter, RouterConfig
+from .server import RouterServer
+
+__all__ = [
+    "AllReplicasUnavailable",
+    "EngineReplica",
+    "HashRing",
+    "PrefixRouter",
+    "ProcessReplica",
+    "Replica",
+    "ReplicaPool",
+    "ReplicaUnavailable",
+    "RouterConfig",
+    "RouterServer",
+]

@@ -1,0 +1,116 @@
+"""Child-process entry point for :class:`~.replicas.ProcessReplica`.
+
+``python -m deeplearning4j_tpu.serving.router.procserver --factory
+pkg.module:callable --factory-json '{...}' --port-file P --stop-file S``
+builds an engine from the factory spec (the procrunner ``"module:attr"``
+reflection idiom), mounts it on a real :class:`~..server.ModelServer` on
+a free port, writes the bound port ATOMICALLY to ``--port-file`` (the
+parent's boot barrier — interpreter + jax startup takes seconds), then
+parks until ``--stop-file`` appears or SIGTERM lands.
+
+``--trace-out`` streams every completed span to a JSONL event log
+(crash-safe), so a multi-process run's traces merge in
+``tools/trace_report.py`` into one cross-process critical path — each
+replica's ``serving.*`` spans carry the trace id the router propagated
+over the ``traceparent`` header.
+
+:func:`tiny_lm_factory` ships here so parity tests can build the SAME
+fixed-seed model in parent and child and compare routed tokens against
+``Transformer.sample(...)`` offline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+
+def tiny_lm_factory(seed: int = 7, vocab_size: int = 64, d_model: int = 32,
+                    n_heads: int = 4, n_layers: int = 2, d_ff: int = 64,
+                    max_len: int = 64, slots: int = 4, resolve_every: int = 4,
+                    max_queue: int = 64, paged: bool = False,
+                    page_size: int = 16, prefix_cache: bool = False):
+    """The test-battery engine: a fixed-seed tiny transformer, identical
+    for identical kwargs in any process."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...models.transformer import TransformerConfig, TransformerLM
+    from ..engine import InferenceEngine, ServingConfig
+
+    cfg = TransformerConfig(vocab_size=vocab_size, d_model=d_model,
+                            n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,
+                            max_len=max_len, dtype=jnp.float32, remat=False,
+                            xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(seed))
+    return InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=slots, resolve_every=resolve_every,
+                          max_queue=max_queue, paged=paged,
+                          page_size=page_size, prefix_cache=prefix_cache))
+
+
+def _resolve(spec: str):
+    """``"pkg.module:attr"`` -> callable (procrunner idiom)."""
+    import importlib
+
+    mod, _, attr = spec.partition(":")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--port-file", required=True)
+    ap.add_argument("--stop-file", required=True)
+    ap.add_argument("--factory", required=True,
+                    help='engine factory as "module:callable"')
+    ap.add_argument("--factory-json", default="{}",
+                    help="JSON kwargs for the factory")
+    ap.add_argument("--trace-out", default=None,
+                    help="stream completed spans to this JSONL file")
+    args = ap.parse_args(argv)
+
+    from ... import observability
+    from ...observability import TRACER
+    from ..server import ModelServer
+
+    observability.enable()
+    if args.trace_out:
+        TRACER.stream_jsonl(args.trace_out)
+
+    engine = _resolve(args.factory)(**json.loads(args.factory_json))
+    engine.start()
+    server = ModelServer(engine=engine)
+    server.start()
+
+    # atomic publish: the parent must never read a half-written port
+    port_file = Path(args.port_file)
+    tmp = port_file.with_suffix(".tmp")
+    tmp.write_text(str(server.port))
+    os.replace(tmp, port_file)
+
+    stop_file = Path(args.stop_file)
+    stopping = {"now": False}
+
+    def _sigterm(_sig, _frm):
+        stopping["now"] = True
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    while not stopping["now"] and not stop_file.exists():
+        time.sleep(0.1)
+
+    server.stop()
+    engine.stop()
+    TRACER.stop_stream()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

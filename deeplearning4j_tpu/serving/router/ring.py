@@ -1,0 +1,88 @@
+"""Consistent-hash ring with virtual nodes (DESIGN.md §19).
+
+Every replica owns ``vnodes`` points on a 64-bit circle (blake2b of
+``"{name}#{i}"``); a key hashes to a point and walks clockwise to the
+first node.  Virtual nodes smooth ownership so equal-weight replicas get
+near-equal key share, and adding/removing one replica remaps only ~1/N
+of the keyspace — the property that makes prefix affinity survive
+elastic membership (a scale-up event must not cold-start every
+replica's KV cache at once).
+
+Quarantine is deliberately NOT a ring operation: ``walk(key)`` yields
+*every* distinct node in clockwise order and the caller filters
+unhealthy ones.  Keeping quarantined nodes on the ring means their
+segment drains to the immediate successors (walk order) while they are
+down and snaps back to the exact original assignment on re-admission —
+removing/re-adding nodes instead would reshuffle ~1/N of *unrelated*
+keys on every breaker transition.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Iterator
+
+
+def _point(data: str) -> int:
+    """64-bit position on the circle for an arbitrary string."""
+    return int.from_bytes(
+        hashlib.blake2b(data.encode(), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Static membership + deterministic clockwise walk.
+
+    Not thread-safe by itself: the router mutates membership only at
+    construction time; a future elastic tier would swap whole rings
+    atomically rather than locking per-lookup.
+    """
+
+    def __init__(self, names: list[str] | None = None, vnodes: int = 64):
+        if vnodes <= 0:
+            raise ValueError("vnodes must be positive")
+        self.vnodes = vnodes
+        self._names: set[str] = set()
+        self._points: list[tuple[int, str]] = []  # sorted (position, name)
+        for name in names or ():
+            self.add(name)
+
+    def add(self, name: str) -> None:
+        if name in self._names:
+            raise ValueError(f"replica {name!r} already on the ring")
+        self._names.add(name)
+        for i in range(self.vnodes):
+            bisect.insort(self._points, (_point(f"{name}#{i}"), name))
+
+    def remove(self, name: str) -> None:
+        if name not in self._names:
+            raise KeyError(name)
+        self._names.discard(name)
+        self._points = [p for p in self._points if p[1] != name]
+
+    def nodes(self) -> list[str]:
+        return sorted(self._names)
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def walk(self, key: str) -> Iterator[str]:
+        """Every distinct node, clockwise from ``key``'s position.  The
+        first yield is the key's owner; successors are its spillover /
+        drain order.  Deterministic for a fixed membership."""
+        if not self._points:
+            return
+        start = bisect.bisect_left(self._points, (_point(key), ""))
+        seen: set[str] = set()
+        n = len(self._points)
+        for off in range(n):
+            name = self._points[(start + off) % n][1]
+            if name not in seen:
+                seen.add(name)
+                yield name
+
+    def primary(self, key: str) -> str | None:
+        """The key's owner (first walk entry), or None on an empty ring."""
+        for name in self.walk(key):
+            return name
+        return None

@@ -1,0 +1,208 @@
+"""Prefix-affinity consistent-hash routing (DESIGN.md §19).
+
+The routing key is the request's content-addressed prefix chain — the
+SAME chained blake2b the :class:`~..paging.PagePool` uses
+(:func:`~..paging.prefix_chain_keys`), over full pages only — truncated
+to the first ``affinity_pages`` pages.  Truncation is the affinity/skew
+trade: hashing the *last* chain key would scatter one tenant's requests
+(every user turn extends the chain), while the first few pages are
+exactly the shared system prompt whose KV pages are worth landing on.
+Prompts too short for one full page fall back to a whole-prompt hash —
+no cached pages exist for them anyway, so any stable spread is fine.
+
+Dispatch walks the ring clockwise from the key, skipping quarantined
+nodes (the pool's breaker), and degrades in order:
+
+- 429 (``QueueFull`` / ``PagePoolExhausted`` / an HTTP 429 answer):
+  the affinity replica is shedding — count ``router.spillover``, note it
+  in the flight recorder (burst trigger), try the next node.  Spillover
+  trades prefix locality for availability, which is why it is a counter
+  and not silent.
+- :class:`ReplicaUnavailable` / timeout / 5xx transport death: feed the
+  pool's breaker (may trip quarantine) and try the next node.
+- 400 / 404 / 504: the request itself is the problem — propagate, a
+  different replica would answer the same.
+
+Every attempt runs inside a ``router.route`` span nested under one
+``router.request`` span, so a request that spilled twice shows three
+route spans under one trace id and ``tools/trace_report.py`` renders the
+router hop on the same critical path as the engine's queue/prefill/
+decode/emit spans (cross-process via the ``traceparent`` header the
+:class:`~..client.ServingClient` already sends).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from ...observability import METRICS, trace
+from ...observability.flightrec import FLIGHTREC
+from ...resilience.faults import FAULTS
+from ..batcher import ServingRejected
+from ..client import ServingError
+from ..paging import prefix_chain_keys
+from .replicas import (AllReplicasUnavailable, Replica, ReplicaPool,
+                       ReplicaUnavailable)
+from .ring import HashRing
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Knobs for ring construction, affinity, spillover and the breaker."""
+
+    page_size: int = 16          # MUST match the replicas' PagePool
+    affinity_pages: int = 4      # chain prefix length the key hashes
+    vnodes: int = 64             # ring points per replica
+    request_timeout_s: float = 60.0
+    max_spill: int | None = None  # extra nodes tried after the owner (None: all)
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 2.0
+    fail_threshold: int = 2      # consecutive failures -> quarantine
+    recover_threshold: int = 2   # consecutive probe successes -> re-admit
+
+
+class PrefixRouter:
+    """Consistent-hash front tier over a :class:`ReplicaPool`."""
+
+    def __init__(self, replicas: list[Replica],
+                 cfg: RouterConfig = RouterConfig()):
+        self.cfg = cfg
+        self.pool = ReplicaPool(
+            replicas,
+            probe_interval_s=cfg.probe_interval_s,
+            probe_timeout_s=cfg.probe_timeout_s,
+            fail_threshold=cfg.fail_threshold,
+            recover_threshold=cfg.recover_threshold)
+        self.ring = HashRing(self.pool.names(), vnodes=cfg.vnodes)
+
+    # ------------------------------------------------------------ routing
+    def routing_key(self, prompt) -> str:
+        """Content-addressed key for ``prompt``: the chain hash of its
+        first ``affinity_pages`` FULL pages (identical to the pool's
+        page addressing), else a whole-prompt fallback hash."""
+        tokens = [int(t) for t in prompt]
+        usable = len(tokens) - 1  # the last token is the first decode query
+        keys = prefix_chain_keys(tokens, usable, self.cfg.page_size)
+        if keys:
+            return keys[min(len(keys), self.cfg.affinity_pages) - 1]
+        return "short:" + hashlib.blake2b(
+            (",".join(map(str, tokens))).encode(), digest_size=16).hexdigest()
+
+    def route_order(self, key: str) -> list[str]:
+        """Active replicas in dispatch order: the owner first, then its
+        clockwise successors (the spillover / quarantine-drain order)."""
+        return [n for n in self.ring.walk(key) if self.pool.is_active(n)]
+
+    # ------------------------------------------------------------ dispatch
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 temperature: float = 0.0, seed: int = 0,
+                 eos_id: int | None = None,
+                 deadline_ms: float | None = None,
+                 timeout_s: float | None = None) -> dict:
+        """Route one generation; returns the replica's completion dict
+        plus ``replica`` (who served it) and ``spills`` (how many nodes
+        were tried before it)."""
+        FAULTS.maybe_fire("router.route")
+        payload = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
+                   "temperature": temperature, "seed": seed}
+        if eos_id is not None:
+            payload["eos_id"] = eos_id
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        timeout = timeout_s if timeout_s is not None \
+            else self.cfg.request_timeout_s
+        key = self.routing_key(prompt)
+        with trace.span("router.request", key=key[:12]):
+            order = self.route_order(key)
+            if not order:
+                METRICS.increment("router.unroutable")
+                raise AllReplicasUnavailable(
+                    "no active replicas on the ring")
+            if self.cfg.max_spill is not None:
+                order = order[: self.cfg.max_spill + 1]
+            last_rejection: ServingRejected | None = None
+            for spills, name in enumerate(order):
+                rep = self.pool.replica(name)
+                self.pool.begin_request(name)
+                try:
+                    with trace.span("router.route", replica=name,
+                                    spills=spills):
+                        out = rep.generate(payload, timeout)
+                except (ReplicaUnavailable, TimeoutError) as e:
+                    # transport-level death: feed the breaker, drain to
+                    # the next ring node
+                    METRICS.increment("router.replica_errors")
+                    self.pool.record_failure(name, f"dispatch: {e}")
+                    last_rejection = e if isinstance(e, ServingRejected) \
+                        else ReplicaUnavailable(str(e))
+                    continue
+                except ServingRejected as e:
+                    if e.status == 429:
+                        # the owner is shedding load: spill clockwise,
+                        # trading prefix locality for availability
+                        METRICS.increment("router.spillover")
+                        FLIGHTREC.note_spillover(name)
+                        last_rejection = e
+                        continue
+                    raise  # 504 deadline etc.: the request's problem
+                except ServingError as e:
+                    if e.status == 429:
+                        METRICS.increment("router.spillover")
+                        FLIGHTREC.note_spillover(name)
+                        last_rejection = _as_rejection(e)
+                        continue
+                    if e.status >= 500:
+                        METRICS.increment("router.replica_errors")
+                        self.pool.record_failure(name, f"dispatch: {e}")
+                        last_rejection = _as_rejection(e)
+                        continue
+                    raise  # 400/404/409: a different replica answers the same
+                finally:
+                    self.pool.end_request(name)
+                self.pool.record_success(name)
+                METRICS.increment("router.requests")
+                if spills == 0:
+                    # landed on the first active ring node for its key —
+                    # the replica whose PagePool holds this prefix
+                    METRICS.increment("router.prefix_affinity_hit")
+                out["replica"] = name
+                out["spills"] = spills
+                return out
+            raise last_rejection if last_rejection is not None else \
+                AllReplicasUnavailable("all replicas failed")
+
+    # ------------------------------------------------------------ admin
+    def reload(self) -> dict[str, int]:
+        """Hot-reload every ACTIVE replica; returns name -> loaded step."""
+        return {name: self.pool.replica(name).reload()
+                for name in self.pool.active_names()}
+
+    def stats(self) -> dict:
+        """Router-level view: per-replica breaker state + load."""
+        out = {}
+        for name in self.pool.names():
+            out[name] = {"active": self.pool.is_active(name),
+                         "last_probe": self.pool.last_probe(name)}
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "PrefixRouter":
+        self.pool.start()
+        return self
+
+    def close(self) -> None:
+        self.pool.close()
+
+    def __enter__(self) -> "PrefixRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _as_rejection(e: ServingError) -> ServingRejected:
+    """Carry a downstream HTTP rejection's status through the router."""
+    rej = ServingRejected(str(e))
+    rej.status = e.status
+    return rej

@@ -1,0 +1,567 @@
+"""Multi-replica serving tier: ring stability, prefix-affinity routing,
+spillover, breaker quarantine/re-admission, the RouterServer HTTP
+surface, and procrunner-spawned process replicas (DESIGN.md §19)."""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu import observability
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.observability import FLIGHTREC, METRICS, TRACER
+from deeplearning4j_tpu.resilience.faults import FaultSpec, inject_faults
+from deeplearning4j_tpu.serving import (EngineReplica, HashRing,
+                                        InferenceEngine, PagePool,
+                                        PrefixRouter, ProcessReplica,
+                                        QueueFull, ReplicaPool,
+                                        ReplicaUnavailable, RouterConfig,
+                                        RouterServer, ServingClient,
+                                        ServingConfig, ServingError,
+                                        ServingRejected, prefix_chain_keys)
+from deeplearning4j_tpu.serving.router.replicas import Replica
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                max_len=32, dtype=jnp.float32, remat=False, xent_chunk=0)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    cfg = tiny_cfg()
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+    return model, params
+
+
+def _expected(model, params, prompt, n, temp, seed):
+    out = model.sample(params, prompt, n, temperature=temp,
+                       key=jax.random.key(seed), kv_cache=True)
+    return [int(t) for t in out[len(prompt):]]
+
+
+# --------------------------------------------------------------------------- ring
+
+def test_ring_walk_yields_every_node_once():
+    ring = HashRing([f"r{i}" for i in range(5)])
+    order = list(ring.walk("some-key"))
+    assert sorted(order) == [f"r{i}" for i in range(5)]
+    assert order == list(ring.walk("some-key"))  # deterministic
+
+
+def test_ring_add_remaps_only_to_new_node():
+    n = 8
+    ring = HashRing([f"r{i}" for i in range(n)])
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.primary(k) for k in keys}
+    ring.add("r-new")
+    moved = {k for k in keys if ring.primary(k) != before[k]}
+    # every remapped key must have moved TO the new node (consistent
+    # hashing's defining property: old nodes never exchange keys) ...
+    assert all(ring.primary(k) == "r-new" for k in moved)
+    # ... and only ~1/(N+1) of the keyspace moves at all
+    assert len(moved) / len(keys) <= 2.0 / (n + 1), (
+        f"{len(moved)}/{len(keys)} keys remapped by one join")
+
+
+def test_ring_remove_remaps_only_the_removed_nodes_keys():
+    ring = HashRing([f"r{i}" for i in range(8)])
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.primary(k) for k in keys}
+    ring.remove("r3")
+    for k in keys:
+        if before[k] != "r3":
+            assert ring.primary(k) == before[k]
+
+
+def test_ring_balance_under_uniform_keys():
+    n = 4
+    ring = HashRing([f"r{i}" for i in range(n)], vnodes=128)
+    counts = {f"r{i}": 0 for i in range(n)}
+    for i in range(4000):
+        counts[ring.primary(f"key-{i}")] += 1
+    for name, c in counts.items():
+        share = c / 4000
+        assert 0.10 <= share <= 0.45, (
+            f"{name} owns {share:.2%} of a uniform keyspace")
+
+
+# --------------------------------------------------------------------------- routing key
+
+def test_routing_key_matches_pool_chain_hash():
+    tokens = list(range(40))
+    pool = PagePool(num_pages=16, page_size=4)
+    assert pool.chain_keys(tokens, 39) == prefix_chain_keys(tokens, 39, 4)
+
+
+def test_routing_key_affinity_prefix_stability():
+    router = PrefixRouter([_StubReplica("r0")],
+                          RouterConfig(page_size=4, affinity_pages=2))
+    system = list(range(8))              # exactly affinity_pages full pages
+    k1 = router.routing_key(system + [1, 2, 3])
+    k2 = router.routing_key(system + [9, 10, 11, 12, 13])
+    assert k1 == k2                      # different user tails, same key
+    assert k1 in prefix_chain_keys(system + [1, 2, 3], 10, 4)
+    # prompts without one full usable page fall back to a whole-prompt hash
+    short = router.routing_key([1, 2])
+    assert short.startswith("short:")
+    assert short != router.routing_key([1, 3])
+
+
+# --------------------------------------------------------------------------- breaker (stubs)
+
+class _StubReplica(Replica):
+    """A replica that answers instantly; ``fail_with`` forces errors."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.calls = 0
+        self.fail_with = None
+
+    def generate(self, payload, timeout_s):
+        self.calls += 1
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"tokens": [1], "finish_reason": "length",
+                "latency_s": 0.0, "ttft_s": 0.0}
+
+    def healthz(self, timeout_s):
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"ok": True, "engine": {}}
+
+
+def _stub_router(n=4, **cfg_kw):
+    kw = dict(page_size=4, affinity_pages=2, fail_threshold=2,
+              recover_threshold=1)
+    kw.update(cfg_kw)
+    stubs = [_StubReplica(f"r{i}") for i in range(n)]
+    return PrefixRouter(stubs, RouterConfig(**kw)), stubs
+
+
+@pytest.mark.lockguard
+def test_spillover_on_429_preserves_availability():
+    observability.enable()
+    router, stubs = _stub_router()
+    prompt = list(range(12))
+    owner = router.route_order(router.routing_key(prompt))[0]
+    stubs[int(owner[1:])].fail_with = QueueFull("shedding")
+    out = router.generate(prompt, 4)
+    assert out["spills"] == 1
+    assert out["replica"] == router.route_order(router.routing_key(prompt))[1]
+    snap = METRICS.snapshot()
+    assert snap["counters"].get("router.spillover") == 1
+    assert snap["counters"].get("router.prefix_affinity_hit") is None
+    # 429 means alive-but-full: the breaker must NOT quarantine for it
+    assert router.pool.is_active(owner)
+    router.close()
+
+
+@pytest.mark.lockguard
+def test_quarantine_and_readmit_restore_assignment():
+    observability.enable()
+    router, stubs = _stub_router(fail_threshold=2)
+    prompt = list(range(12))
+    key = router.routing_key(prompt)
+    original_order = router.route_order(key)
+    owner = original_order[0]
+    stub = stubs[int(owner[1:])]
+
+    stub.fail_with = ReplicaUnavailable(f"replica {owner} wedged")
+    for _ in range(2):                   # fail_threshold dispatch failures
+        out = router.generate(prompt, 4)
+        assert out["replica"] == original_order[1]   # drained to successor
+    assert not router.pool.is_active(owner)
+    # quarantined: the ring segment drains WITHOUT remapping other keys
+    assert router.route_order(key) == original_order[1:]
+
+    # a probe sweep while still down keeps it quarantined
+    router.pool.probe_once()
+    assert not router.pool.is_active(owner)
+
+    # recovery: probes succeed again -> re-admitted, assignment restored
+    stub.fail_with = None
+    router.pool.probe_once()
+    assert router.pool.is_active(owner)
+    assert router.route_order(key) == original_order
+    assert router.generate(prompt, 4)["replica"] == owner
+
+    snap = METRICS.snapshot()
+    assert snap["counters"].get("router.quarantines") == 1
+    assert snap["counters"].get("router.readmissions") == 1
+    router.close()
+
+
+def test_quarantine_dumps_flightrec_bundle_naming_replica(tmp_path):
+    observability.enable()
+    router, stubs = _stub_router(fail_threshold=1)
+    router.pool.probe_once()             # record a healthy last_probe first
+    prompt = list(range(12))
+    owner = router.route_order(router.routing_key(prompt))[0]
+    stubs[int(owner[1:])].fail_with = ReplicaUnavailable("dead")
+    router.generate(prompt, 4)
+    bundles = sorted(FLIGHTREC.dump_dir.glob(
+        "flightrec-router_replica_quarantine-*.json"))
+    assert bundles, "quarantine left no flight-recorder bundle"
+    bundle = json.loads(bundles[-1].read_text())
+    assert bundle["extra"]["replica"] == owner
+    assert bundle["extra"]["last_probe"], "bundle lost the last health probe"
+    router.close()
+
+
+def test_all_replicas_down_is_503_not_a_hang():
+    router, stubs = _stub_router(n=2, fail_threshold=1)
+    for s in stubs:
+        s.fail_with = ReplicaUnavailable("down")
+    t0 = time.monotonic()
+    with pytest.raises(ServingRejected) as ei:
+        router.generate(list(range(12)), 4)
+    assert ei.value.status == 503
+    assert time.monotonic() - t0 < 5.0
+    router.close()
+
+
+def test_spillover_burst_dumps_bundle():
+    observability.enable()
+    for _ in range(FLIGHTREC.spill_burst_n):
+        path = FLIGHTREC.note_spillover("r1")
+    assert path is not None and path.exists()
+    bundle = json.loads(path.read_text())
+    assert bundle["trigger"] == "router_spillover_burst"
+    assert "r1" in bundle["extra"]["recent_replicas"]
+
+
+# --------------------------------------------------------------------------- routing (engines)
+
+@pytest.mark.lockguard
+def test_affinity_and_token_parity_through_router(lm):
+    model, params = lm
+    observability.enable()
+    engines = [InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                          prefix_cache=True)) for _ in range(2)]
+    for e in engines:
+        e.start(warmup=False)
+    reps = [EngineReplica(f"r{i}", e, own_engine=True)
+            for i, e in enumerate(engines)]
+    router = PrefixRouter(reps, RouterConfig(page_size=4, affinity_pages=2))
+    system = [5, 9, 13, 2, 30, 41, 8, 19]          # 2 full pages shared
+    served_by = set()
+    for i, tail in enumerate(([3], [7, 11], [22, 1, 60])):
+        prompt = system + tail
+        out = router.generate(prompt, 5, temperature=0.0, seed=100 + i)
+        assert out["tokens"] == _expected(model, params, prompt, 5, 0.0,
+                                          100 + i)
+        assert out["spills"] == 0
+        served_by.add(out["replica"])
+    # one tenant, one replica: that is what affinity means
+    assert len(served_by) == 1
+    snap = METRICS.snapshot()
+    assert snap["counters"]["router.prefix_affinity_hit"] == 3
+    # the pool-weighted aggregate hit-rate gauge comes from a probe sweep
+    router.pool.probe_once()
+    assert METRICS.snapshot()["gauges"]["router.prefix_hit_rate"] > 0.0
+    router.close()
+
+
+def test_spilled_requests_keep_token_parity(lm):
+    model, params = lm
+    observability.enable()
+    engines = [InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=1, resolve_every=2, max_queue=3))
+        for _ in range(2)]
+    for e in engines:
+        e.start(warmup=False)
+    reps = [EngineReplica(f"r{i}", e, own_engine=True)
+            for i, e in enumerate(engines)]
+    router = PrefixRouter(reps, RouterConfig(page_size=4, affinity_pages=2))
+    system = [5, 9, 13, 2, 30, 41, 8, 19]
+    plans = [(system + [i], 12, 7000 + i) for i in range(6)]
+    outs: dict[int, dict] = {}
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(len(plans))
+
+    def fire(idx, prompt, n, seed):
+        barrier.wait()
+        try:
+            outs[idx] = router.generate(prompt, n, temperature=0.0, seed=seed)
+        except BaseException as e:       # noqa: BLE001 - re-raised below
+            errors.append(e)
+
+    ts = [threading.Thread(target=fire, args=(i, *p))
+          for i, p in enumerate(plans)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60.0)
+    assert not errors, errors
+    assert len(outs) == len(plans)
+    # one tenant hammering one replica's 3-deep capacity with 6 parallel
+    # requests MUST shed some onto the ring successor ...
+    # the 6-burst lands before any slot pops, so the owner can absorb at
+    # most max_queue of it and MUST shed the rest onto the successor
+    spilled = [o for o in outs.values() if o["spills"] > 0]
+    assert spilled, "no spillover under 2x oversubscription"
+    assert METRICS.snapshot()["counters"]["router.spillover"] >= 1
+    # ... and a spilled request's tokens are indistinguishable from the
+    # affinity replica's (same params, same seed, same sampler)
+    for idx, (prompt, n, seed) in enumerate(plans):
+        assert outs[idx]["tokens"] == _expected(model, params, prompt, n,
+                                                0.0, seed)
+    router.close()
+
+
+def test_chaos_replica_down_quarantine_and_readmission(lm):
+    """The ISSUE's chaos plan: one of 4 replicas dies mid-workload —
+    requests re-route without hanging, other replicas' tenants are
+    undisturbed, and the ring re-admits the replica on recovery."""
+    model, params = lm
+    observability.enable()
+    engines = [InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                          prefix_cache=True)) for _ in range(4)]
+    for e in engines:
+        e.start(warmup=False)
+    reps = [EngineReplica(f"r{i}", e, own_engine=True)
+            for i, e in enumerate(engines)]
+    router = PrefixRouter(reps, RouterConfig(
+        page_size=4, affinity_pages=2, fail_threshold=1, recover_threshold=1,
+        probe_interval_s=0.05)).start()
+
+    # two tenants owned by two DIFFERENT replicas
+    rng_prompts = ([5, 9, 13, 2, 30, 41, 8, 19, 3],
+                   [1, 1, 2, 3, 5, 8, 13, 21, 34],
+                   [60, 59, 58, 57, 56, 55, 54, 53, 2],
+                   [7, 7, 7, 7, 7, 7, 7, 7, 7])
+    owners = {p: router.route_order(router.routing_key(p))[0]
+              for p in map(tuple, rng_prompts)}
+    victim_prompt = list(rng_prompts[0])
+    victim = owners[tuple(rng_prompts[0])]
+    other_prompt = next(list(p) for p, o in owners.items() if o != victim)
+
+    with inject_faults(FaultSpec("router.replica_down", probability=1.0,
+                                 max_fires=0, kind=victim)):
+        t0 = time.monotonic()
+        out = router.generate(victim_prompt, 4, temperature=0.0, seed=11)
+        # failed fast onto a successor, never hung on the dead replica
+        # (spills is 1 when the dispatch raced ahead of the prober, 0
+        # once the breaker had already drained the ring segment)
+        assert time.monotonic() - t0 < 10.0
+        assert out["replica"] != victim and out["spills"] in (0, 1)
+        assert out["tokens"] == _expected(model, params, victim_prompt, 4,
+                                          0.0, 11)
+        assert not router.pool.is_active(victim)
+        # an unrelated tenant on a healthy replica is undisturbed
+        out2 = router.generate(other_prompt, 4, temperature=0.0, seed=12)
+        assert out2["replica"] == owners[tuple(other_prompt)]
+        assert out2["spills"] == 0
+
+    # recovery: the fault is disarmed, probes succeed, ring re-admits
+    deadline = time.monotonic() + 5.0
+    while not router.pool.is_active(victim) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert router.pool.is_active(victim), "replica never re-admitted"
+    out3 = router.generate(victim_prompt, 4, temperature=0.0, seed=13)
+    assert out3["replica"] == victim
+    bundles = list(FLIGHTREC.dump_dir.glob(
+        "flightrec-router_replica_quarantine-*.json"))
+    assert bundles, "chaos quarantine left no evidence bundle"
+    router.close()
+
+
+def test_injected_route_fault_maps_to_503(lm):
+    router, _ = _stub_router()
+    with inject_faults(FaultSpec("router.route", probability=1.0)):
+        with pytest.raises(Exception) as ei:
+            router.generate(list(range(12)), 4)
+    assert "router.route" in str(ei.value)
+    router.close()
+
+
+# --------------------------------------------------------------------------- HTTP front end
+
+def test_router_server_http_surface(lm):
+    model, params = lm
+    observability.enable()
+    engines = [InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                          prefix_cache=True)) for _ in range(2)]
+    for e in engines:
+        e.start(warmup=False)
+    reps = [EngineReplica(f"r{i}", e, own_engine=True)
+            for i, e in enumerate(engines)]
+    router = PrefixRouter(reps, RouterConfig(page_size=4, affinity_pages=2,
+                                             probe_interval_s=0.05))
+    prompt = [5, 9, 13, 2, 30, 41, 8, 19, 3]
+    with RouterServer(router) as server:
+        client = ServingClient(port=server.port)
+        from deeplearning4j_tpu.observability import trace
+        with trace.span("client.generate") as sp:
+            out = client.generate(prompt, 5, temperature=0.0, seed=42)
+        assert out["tokens"] == _expected(model, params, prompt, 5, 0.0, 42)
+        assert out["replica"] in ("r0", "r1") and out["spills"] == 0
+
+        # the caller's trace id spans client -> router hop -> engine
+        names_in_trace = {ev["name"] for ev in TRACER.to_chrome_trace()
+                          ["traceEvents"]
+                          if (ev.get("args") or {}).get("trace_id")
+                          == sp.trace_id}
+        assert {"router.request", "router.route",
+                "serving.request"} <= names_in_trace
+
+        health = client.healthz()
+        assert health["ok"] and set(health["replicas"]) == {"r0", "r1"}
+        assert all(v["active"] for v in health["replicas"].values())
+
+        prom = client.metrics_prom()
+        assert "router_requests_total" in prom
+        assert "router_replica_state_r0" in prom
+
+        # rejection statuses are the API: malformed prompt -> 400
+        with pytest.raises(ServingError) as ei:
+            client.generate([999], 4)
+        assert ei.value.status == 400
+
+        # reload passes the replica's own answer through: these engines
+        # serve from in-memory params, so the 409 survives the hop
+        with pytest.raises(ServingError) as ei2:
+            client._json("/v1/reload", {})
+        assert ei2.value.status == 409
+
+
+# --------------------------------------------------------------------------- process replicas
+
+def test_process_replica_parity_and_fail_fast(lm, tmp_path):
+    model, params = lm
+    observability.enable()
+    rep = ProcessReplica(
+        "p0", "deeplearning4j_tpu.serving.router.procserver:tiny_lm_factory",
+        tmp_path, factory_kwargs={"max_len": 32, "slots": 2,
+                                  "paged": True, "page_size": 4,
+                                  "prefix_cache": True},
+        env={"JAX_PLATFORMS": "cpu"}, client_timeout_s=30.0)
+    router = PrefixRouter([rep], RouterConfig(page_size=4, affinity_pages=2,
+                                              fail_threshold=1))
+    try:
+        prompt = [5, 9, 13, 2, 30, 41, 8, 19, 3]
+        out = router.generate(prompt, 5, temperature=0.0, seed=21)
+        # the child built the SAME fixed-seed model: parity across the
+        # process boundary, through router + HTTP + engine
+        assert out["tokens"] == _expected(model, params, prompt, 5, 0.0, 21)
+        assert out["replica"] == "p0"
+        health = rep.healthz(5.0)
+        assert health["ok"] and health["engine"]["prefix_lookups"] >= 1
+
+        # SIGKILL mid-service: requests fail FAST (503), never hang
+        rep.kill()
+        t0 = time.monotonic()
+        with pytest.raises(ServingRejected) as ei:
+            router.generate(prompt, 5, temperature=0.0, seed=22)
+        assert ei.value.status == 503
+        assert time.monotonic() - t0 < 15.0
+        assert not router.pool.is_active("p0")
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------------- client transport
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Resets the first ``fail_gets`` GET connections; counts POSTs."""
+
+    fail_gets = {"n": 1}
+    posts = {"n": 0}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.fail_gets["n"] > 0:
+            self.fail_gets["n"] -= 1
+            self.connection.close()      # mid-flight connection reset
+            return
+        body = b'{"ok": true}'
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        self.posts["n"] += 1
+        self.connection.close()          # always reset: POSTs must not retry
+
+
+def test_client_retries_idempotent_gets_only():
+    _FlakyHandler.fail_gets["n"] = 1
+    _FlakyHandler.posts["n"] = 0
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = ServingClient(port=server.server_address[1], timeout_s=5.0,
+                               retries=1, retry_backoff_s=0.01)
+        # the first connection dies mid-flight; the single idempotent
+        # retry recovers the health probe
+        assert client.healthz() == {"ok": True}
+        # POSTs never retry: the request may have executed server-side
+        with pytest.raises(OSError):
+            client.generate([1, 2, 3], 4)
+        assert _FlakyHandler.posts["n"] == 1
+    finally:
+        server.shutdown()
+        thread.join(timeout=10.0)
+        server.server_close()
+
+
+def test_client_timeout_is_bounded():
+    # a socket that accepts and then never answers: the per-call timeout
+    # must bound the probe, not hang it
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    try:
+        client = ServingClient(port=sock.getsockname()[1], timeout_s=60.0,
+                               retries=0)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            client.healthz(timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        sock.close()
+
+
+# --------------------------------------------------------------------------- tooling
+
+def test_metrics_dump_renders_router_table():
+    from tools.metrics_dump import render_router
+
+    snap = {
+        "gauges": {"router.replica_state.r0": 1.0,
+                   "router.replica_state.r1": 0.0,
+                   "router.replica_load.r0": 2.0,
+                   "router.replica_queue_depth.r0": 3.0,
+                   "router.prefix_hit_rate": 0.75},
+        "counters": {"router.requests": 40.0,
+                     "router.prefix_affinity_hit": 36.0,
+                     "router.spillover": 4.0,
+                     "router.quarantines": 1.0},
+    }
+    table = render_router(snap)
+    assert table is not None
+    assert "r0" in table and "active" in table and "quarantined" in table
+    assert "75.0%" in table and "spillover" in table and "90.0%" in table
+    # non-router snapshots stay silent
+    assert render_router({"gauges": {}, "counters": {}}) is None

@@ -109,6 +109,50 @@ def render_serving(snap: dict) -> str | None:
                  ("metric", "value"))
 
 
+def render_router(snap: dict) -> str | None:
+    """Multi-replica router tier (PR 11): per-replica breaker state /
+    in-flight load / queue depth, plus the aggregate affinity, spillover
+    and quarantine story.  Returns None when the job published no
+    ``router.*`` gauges (single-replica or non-serving jobs)."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    names: set[str] = set()
+    for prefix in ("router.replica_state.", "router.replica_load.",
+                   "router.replica_queue_depth."):
+        for k in gauges:
+            if k.startswith(prefix):
+                names.add(k[len(prefix):])
+    if not names and not any(k.startswith("router.") for k in counters):
+        return None
+    rows = []
+    for n in sorted(names):
+        state = gauges.get(f"router.replica_state.{n}")
+        rows.append((
+            n,
+            "?" if state is None else ("active" if state else "quarantined"),
+            f"{gauges.get(f'router.replica_load.{n}', 0.0):.0f}",
+            f"{gauges.get(f'router.replica_queue_depth.{n}', 0.0):.0f}"))
+    out = [_rows("router (per replica)", rows,
+                 ("replica", "state", "inflight", "queue_depth"))]
+    summary = []
+    reqs = counters.get("router.requests")
+    if reqs:
+        summary.append(("requests", f"{reqs:.0f}"))
+        hits = counters.get("router.prefix_affinity_hit", 0.0)
+        summary.append(("prefix_affinity", f"{hits / reqs * 100:.1f}%"))
+    if "router.prefix_hit_rate" in gauges:
+        summary.append(("aggregate_prefix_hit_rate",
+                        f"{gauges['router.prefix_hit_rate'] * 100:.1f}%"))
+    for name in ("router.spillover", "router.quarantines",
+                 "router.readmissions", "router.replica_errors"):
+        if name in counters:
+            summary.append((name.split(".", 1)[1],
+                            f"{counters[name]:.0f}"))
+    if summary:
+        out.append(_rows("router (aggregate)", summary, ("metric", "value")))
+    return "\n\n".join(out)
+
+
 def render_utilization(snap: dict) -> str | None:
     """MFU / memory-bandwidth gauges from the analytic cost model
     (``observability.cost``): published by the trainer, the decode loop
@@ -129,7 +173,8 @@ def render_metrics(snap: dict) -> str:
     state_mem = render_state_memory(snap)
     if state_mem is not None:
         parts.append(state_mem)
-    for section in (render_serving(snap), render_utilization(snap)):
+    for section in (render_serving(snap), render_router(snap),
+                    render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(

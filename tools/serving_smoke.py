@@ -26,6 +26,22 @@ FAILS unless every completed request's trace carries the full
 queue_wait -> prefill -> decode -> emit chain under one trace id.  Feed
 the file to ``tools/trace_report.py`` for the per-request TTFT breakdown.
 
+``--replicas N`` switches to the multi-replica router smoke: the SAME
+Zipf multi-tenant workload is run twice through a ``RouterServer`` —
+once over a single replica, once over N — with the aggregate
+pool-weighted prefix hit rate scraped from the router's
+``/metrics.prom`` exactly as a Prometheus poller would see it.  The run
+FAILS unless the N-replica aggregate hit rate is at least the
+single-replica run's (prefix affinity must not shred locality across
+the ring), the affinity rate (requests landing on their ring owner) is
+high, throughput does not collapse versus one replica, and every
+temperature-0 completion — including any that spilled — matches
+``Transformer.sample`` offline token-for-token.  ``--strict-scaling``
+additionally asserts near-linear throughput (>= 0.6*N); the default
+floor is lenient because a tiny CPU model is GIL/dispatch-bound — the
+near-linear claim is owed to the real-hardware battery (ROADMAP item 2),
+and the JSON line always reports the measured ratio.
+
 ``--prefix-workload`` switches to the paged/prefix-cache smoke: a
 Zipf-skewed population of shared system prompts (the multi-tenant
 chatbot shape) is served by a ``paged=True, prefix_cache=True`` engine
@@ -391,11 +407,236 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
     return result
 
 
+def run_replicas(requests: int = 48, threads: int = 8, seed: int = 0,
+                 replicas: int = 4, page_size: int = 6,
+                 lockguard: bool = False, trace_out: str | None = None,
+                 strict_scaling: bool = False) -> dict:
+    """The ``--replicas N`` leg: one Zipf multi-tenant workload, run
+    against a single-replica router and then an N-replica router, with
+    affinity / aggregate-hit-rate / throughput / parity assertions."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS, TRACER, trace
+    from deeplearning4j_tpu.serving import (EngineReplica, InferenceEngine,
+                                            PrefixRouter, RouterConfig,
+                                            RouterServer, ServingClient,
+                                            ServingConfig, ServingError)
+
+    observability.enable()
+    METRICS.reset()
+    if trace_out is not None:
+        TRACER.clear()
+
+    guard = None
+    if lockguard:
+        from deeplearning4j_tpu.analysis.lockguard import LockGuard
+
+        guard = LockGuard().install()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+
+    rng = random.Random(seed)
+    n_tenants = 6
+    sys_prompts = [[rng.randrange(cfg.vocab_size)
+                    for _ in range(4 * page_size)] for _ in range(n_tenants)]
+    zipf_w = [1.0 / (r + 1) ** 1.5 for r in range(n_tenants)]
+    plans = []
+    for _ in range(requests):
+        tenant = rng.choices(range(n_tenants), weights=zipf_w)[0]
+        user = [rng.randrange(cfg.vocab_size)
+                for _ in range(rng.randint(1, 5))]
+        plans.append(dict(prompt=sys_prompts[tenant] + user,
+                          max_new_tokens=rng.randint(1, 8),
+                          temperature=rng.choice([0.0, 0.7]),
+                          seed=rng.randrange(1 << 20)))
+
+    rcfg = RouterConfig(page_size=page_size, affinity_pages=4,
+                        probe_interval_s=0.1, fail_threshold=2,
+                        recover_threshold=2)
+
+    def one_leg(n: int, want_traces: bool) -> dict:
+        """Drive the full workload through a RouterServer over n fresh
+        in-process replicas; returns scraped + client-side measurements."""
+        METRICS.reset()
+        failures: list[str] = []
+        results: list[tuple[dict, dict]] = []     # (plan, completion)
+        traces: list[str] = []
+        lock = threading.Lock()
+        engines = [InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=2, resolve_every=4, paged=True,
+                              page_size=page_size, prefix_cache=True))
+            for _ in range(n)]
+        reps = [EngineReplica(f"r{i}", e, own_engine=True)
+                for i, e in enumerate(engines)]
+        for e in engines:
+            e.start()
+        router = PrefixRouter(reps, rcfg)
+        with RouterServer(router) as server:
+            client = ServingClient(port=server.port)
+
+            def worker(mine):
+                for plan in mine:
+                    try:
+                        with trace.span("client.generate") as sp:
+                            out = client.generate(**plan)
+                        with lock:
+                            results.append((plan, out))
+                            if want_traces and getattr(sp, "trace_id", ""):
+                                traces.append(sp.trace_id)
+                    except ServingError as e:
+                        with lock:
+                            failures.append(str(e))
+
+            t0 = _time.perf_counter()
+            ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            wall_s = _time.perf_counter() - t0
+            _time.sleep(3 * rcfg.probe_interval_s)  # let the prober publish
+            prom = client.metrics_prom()
+            health = client.healthz()
+        scraped = _scrape_gauges(prom, ("router_prefix_hit_rate",))
+        counters = _scrape_counters(
+            prom, ("router_requests_total", "router_prefix_affinity_hit_total",
+                   "router_spillover_total", "router_quarantines_total"))
+        tokens = sum(len(o["tokens"]) for _, o in results)
+        return {"replicas": n, "wall_s": wall_s, "tokens": tokens,
+                "tokens_per_sec": tokens / wall_s if wall_s else 0.0,
+                "completed": len(results), "failures": failures,
+                "hit_rate": scraped.get("router_prefix_hit_rate", 0.0),
+                "counters": counters, "results": results,
+                "traces": traces, "health": health}
+
+    single = one_leg(1, want_traces=False)
+    multi = one_leg(replicas, want_traces=trace_out is not None)
+
+    failures = single["failures"] + multi["failures"]
+
+    # token parity, including spilled requests: every temperature-0
+    # completion must equal the offline sample for its seed
+    parity_checked = 0
+    for plan, out in multi["results"]:
+        if plan["temperature"] != 0.0 or parity_checked >= 8:
+            continue
+        exp = model.sample(params, plan["prompt"], plan["max_new_tokens"],
+                           temperature=0.0, key=jax.random.key(plan["seed"]),
+                           kv_cache=True)[len(plan["prompt"]):]
+        if out["tokens"] != [int(t) for t in exp]:
+            failures.append(f"parity mismatch on replica {out['replica']} "
+                            f"(spills={out['spills']})")
+        parity_checked += 1
+
+    trace_summary = None
+    if trace_out is not None:
+        from tools.trace_report import merge, request_breakdowns
+        TRACER.save_chrome_trace(trace_out)
+        merged = merge([trace_out])
+        with open(trace_out, "w") as f:
+            json.dump(merged, f)
+        by_trace: dict[str, set] = {}
+        for ev in merged["traceEvents"]:
+            tid = (ev.get("args") or {}).get("trace_id")
+            if tid:
+                by_trace.setdefault(tid, set()).add(ev["name"])
+        need = {"router.request", "router.route", "serving.request",
+                "serving.queue_wait", "serving.prefill", "serving.emit"}
+        for tid in multi["traces"]:
+            missing = need - by_trace.get(tid, set())
+            if missing:
+                failures.append(
+                    f"trace {tid[:12]} missing spans {sorted(missing)}")
+        routed_rows = [r for r in request_breakdowns(merged["traceEvents"])
+                       if r["route_hops"]]
+        if not routed_rows:
+            failures.append("trace_report shows no router hop on any request")
+        trace_summary = {"path": trace_out,
+                         "events": len(merged["traceEvents"]),
+                         "requests_traced": len(multi["traces"]),
+                         "routed_breakdown_rows": len(routed_rows)}
+
+    if guard is not None:
+        guard.uninstall()
+        guard.emit_metrics()
+        for v in guard.violations():
+            failures.append(str(v))
+
+    reqs = multi["counters"].get("router_requests_total", 0.0)
+    affinity = (multi["counters"].get("router_prefix_affinity_hit_total", 0.0)
+                / reqs if reqs else 0.0)
+    scaling = (multi["tokens_per_sec"] / single["tokens_per_sec"]
+               if single["tokens_per_sec"] else 0.0)
+    result = {
+        "workload": "replicas",
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "replicas": replicas,
+        "page_size": page_size,
+        "completed": multi["completed"],
+        "single_hit_rate": single["hit_rate"],
+        "aggregate_hit_rate": multi["hit_rate"],
+        "prefix_affinity_rate": affinity,
+        "spillover": multi["counters"].get("router_spillover_total", 0.0),
+        "quarantines": multi["counters"].get("router_quarantines_total", 0.0),
+        "single_tokens_per_sec": single["tokens_per_sec"],
+        "tokens_per_sec": multi["tokens_per_sec"],
+        "throughput_scaling": scaling,
+        "parity_checked": parity_checked,
+        "failures": failures[:5],
+    }
+    if trace_summary is not None:
+        result["trace"] = trace_summary
+    if guard is not None:
+        result["lockguard_violations"] = len(guard.violations())
+    assert not failures, failures[:5]
+    assert single["completed"] == requests and multi["completed"] == requests
+    assert parity_checked > 0, "no temperature-0 plans to parity-check"
+    assert multi["hit_rate"] >= single["hit_rate"] - 0.05, (
+        f"aggregate prefix hit rate {multi['hit_rate']:.3f} fell below the "
+        f"single-replica run {single['hit_rate']:.3f} — affinity routing is "
+        "shredding locality")
+    assert affinity >= 0.9 - (result["spillover"] / max(reqs, 1.0)), (
+        f"prefix affinity rate {affinity:.3f} too low for a healthy ring")
+    floor = 0.6 * replicas if strict_scaling else 0.8
+    assert scaling >= floor, (
+        f"throughput scaling {scaling:.2f}x under the {floor:.2f}x floor "
+        f"({replicas} replicas)")
+    return result
+
+
+def _scrape_counters(prom_text: str, names: tuple[str, ...]) -> dict:
+    """Counter samples (``name_total value``) from a Prometheus page."""
+    return _scrape_gauges(prom_text, names)
+
+
 def main(argv: list[str]) -> int:
     def arg(flag, default, cast=int):
         return cast(argv[argv.index(flag) + 1]) if flag in argv else default
 
-    if "--prefix-workload" in argv:
+    if "--replicas" in argv:
+        out = run_replicas(requests=arg("--requests", 48),
+                           threads=arg("--threads", 8),
+                           seed=arg("--seed", 0),
+                           replicas=arg("--replicas", 4),
+                           page_size=arg("--page-size", 6),
+                           lockguard="--lockguard" in argv,
+                           trace_out=arg("--trace-out", None, str),
+                           strict_scaling="--strict-scaling" in argv)
+    elif "--prefix-workload" in argv:
         out = run_prefix(requests=arg("--requests", 32),
                          threads=arg("--threads", 4),
                          seed=arg("--seed", 0),

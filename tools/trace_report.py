@@ -17,7 +17,16 @@ The report groups serving spans by ``args.trace_id`` (the W3C trace id
 minted at admission or propagated via ``traceparent``) and prints, per
 request, the critical-path breakdown the engine records:
 
-    queue_wait | prefill | decode (sum of segments) | emit | TTFT | total
+    queue_wait | route | prefill | decode (sum of segments) | emit | TTFT | total
+
+``route`` is the router hop (``router.route`` spans from the
+multi-replica tier, PR 11) — for a request that spilled over, every
+attempted replica's span counts, so the column is the full routing cost,
+and ``hops`` shows how many replicas were tried.  Single-replica runs
+show ``-``.  Spans from a router process and a replica process share the
+trace id via the ``traceparent`` header; their ``ts`` anchors differ per
+process (perf_counter epochs), so columns are durations, never
+cross-process timestamp differences.
 
 TTFT here is time from submission to the end of prefill — the first
 token exists when prefill's last dispatch resolves.  Requests missing a
@@ -73,10 +82,12 @@ def merge(paths: list[str]) -> dict:
 
 
 def _by_request(events: list[dict]) -> dict[str, dict[str, list[dict]]]:
-    """trace_id -> span name -> events, for serving.* spans only."""
+    """trace_id -> span name -> events, for serving.* and router.* spans."""
     out: dict[str, dict[str, list[dict]]] = {}
     for ev in events:
-        if ev.get("ph") != "X" or not str(ev.get("name", "")).startswith("serving."):
+        name = str(ev.get("name", ""))
+        if ev.get("ph") != "X" or not (name.startswith("serving.")
+                                       or name.startswith("router.")):
             continue
         tid = (ev.get("args") or {}).get("trace_id")
         if not tid:
@@ -102,10 +113,13 @@ def request_breakdowns(events: list[dict]) -> list[dict]:
         if prefills:
             p = prefills[0]
             ttft_ms = (p["ts"] + p.get("dur", 0.0) - root["ts"]) / 1e3
+        route_hops = len(spans.get("router.route", ()))
         rows.append({
             "trace_id": trace_id,
             "start_ts_us": root["ts"],
             "queue_wait_ms": total_ms("serving.queue_wait"),
+            "route_ms": total_ms("router.route") if route_hops else None,
+            "route_hops": route_hops,
             "prefill_ms": total_ms("serving.prefill"),
             "decode_ms": total_ms("serving.decode.segment"),
             "decode_segments": len(spans.get("serving.decode.segment", ())),
@@ -126,9 +140,10 @@ def render(rows: list[dict], limit: int) -> str:
     def ms(v):
         return "-" if v is None else f"{v:.2f}"
 
-    headers = ("trace_id", "queue", "prefill", "decode", "segs",
-               "emit", "ttft", "total", "tokens")
-    cells = [(r["trace_id"][:12], ms(r["queue_wait_ms"]), ms(r["prefill_ms"]),
+    headers = ("trace_id", "queue", "route", "hops", "prefill", "decode",
+               "segs", "emit", "ttft", "total", "tokens")
+    cells = [(r["trace_id"][:12], ms(r["queue_wait_ms"]), ms(r["route_ms"]),
+              str(r["route_hops"] or "-"), ms(r["prefill_ms"]),
               ms(r["decode_ms"]), str(r["decode_segments"]), ms(r["emit_ms"]),
               ms(r["ttft_ms"]), ms(r["total_ms"]), str(r["tokens"] or "-"))
              for r in shown]
