@@ -962,7 +962,8 @@ def _kernel_picks():
                             ("layernorm_residual", "unfused"),
                             ("xent", "scan"),
                             ("int8_matmul", "f32"),
-                            ("paged_attention", "gather")):
+                            ("paged_attention", "gather"),
+                            ("paged_attention_int8", "gather_int8")):
         try:
             table[kind] = kernel_registry.autopick(
                 kind, rows, incumbent=incumbent).as_dict()

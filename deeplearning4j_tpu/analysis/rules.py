@@ -1,4 +1,4 @@
-"""The graftlint rule set — fifteen hazard classes from this repo's history.
+"""The graftlint rule set — sixteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -40,6 +40,10 @@
 | OB01  | direct `time.monotonic()`/`perf_counter()` timing of dispatch    |
 |       | in `serving/`/`parallel/` with no registry/tracer call in reach  |
 |       | — the measurement exists nowhere a scrape or trace can see       |
+| QT01  | raw `.astype(jnp.int8)`/`.astype(jnp.float8_*)` in `serving/`    |
+|       | or `models/` outside the quant helpers — an unscaled,            |
+|       | unsaturated cast that silently wraps/overflows instead of going  |
+|       | through `kv_quant.cast_to`/`matmul_int8.quantize`                |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -1202,3 +1206,65 @@ class UnregisteredTimingRule(Rule):
                 "METRICS/trace — the measurement is invisible to scrapes "
                 "and traces; record it via METRICS.observe_time/time() or "
                 "trace.record_span (or silence with a reason)")
+
+
+@register
+class RawQuantCastRule(Rule):
+    """QT01 — ad-hoc KV/weight precision casts outside the quant helpers.
+
+    ``x.astype(jnp.int8)`` wraps on overflow (numpy semantics: 300 →
+    44) and ``.astype(jnp.float8_*)`` rounds with no absmax scaling —
+    neither is a quantization.  Every sound low-precision write in this
+    tree goes through a helper that scales THEN saturates
+    (``ops/pallas/kv_quant.cast_to`` for cache pages,
+    ``ops/pallas/matmul_int8.quantize`` for weights), which is also
+    where the paired scale tensor is produced.  A raw cast in
+    ``serving/`` or ``models/`` means a value reached storage precision
+    without a scale beside it — the bug class where a page quantizes
+    fine on small activations and silently wraps on the first outlier.
+    Scoped to those two trees; the helpers themselves (``ops/pallas/``)
+    are the one place a raw cast is the point.
+
+    Blind spots: a dtype smuggled through a variable
+    (``dt = jnp.int8; x.astype(dt)``); ``jnp.asarray(x, jnp.int8)``.
+    Silence a deliberate storage-layer cast with
+    ``# graftlint: disable=QT01`` plus the reason.
+    """
+
+    id = "QT01"
+    title = "raw int8/fp8 cast outside the quant helpers"
+
+    _QUANT_DTYPES = {"jax.numpy.int8", "jnp.int8", "numpy.int8"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "serving/" not in path and "models/" not in path:
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"):
+                continue
+            dtype_arg = None
+            if node.args:
+                dtype_arg = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype_arg = kw.value
+            if dtype_arg is None:
+                continue
+            name = (module.canonical(dtype_arg)
+                    or dotted_name(dtype_arg) or "")
+            seg = last_segment(name) or ""
+            if not (name in self._QUANT_DTYPES
+                    or seg.startswith("float8_")):
+                continue
+            yield self.finding(
+                module, node,
+                f"raw `.astype({seg})` — an unscaled, unsaturated cast "
+                "to storage precision (int8 wraps on overflow, fp8 "
+                "rounds with no absmax); quantize through "
+                "`kv_quant.cast_to`/`requantize_pool` or "
+                "`matmul_int8.quantize` so a scale rides beside the "
+                "bytes (or silence with a reason)")

@@ -85,11 +85,27 @@ class TransformerConfig:
     #                                  at batch 64/seq 512/32k vocab);
     #                                  chunking + per-chunk remat streams
     #                                  them through VMEM-sized pieces instead
+    n_kv_heads: int | None = None    # GQA/MQA: K/V heads shared by groups of
+    #                                  n_heads // n_kv_heads query heads.
+    #                                  None (or == n_heads) keeps today's
+    #                                  full-attention layout byte-identical;
+    #                                  1 is MQA.  Cache shapes (dense rows
+    #                                  and page pools) are sized by this, so
+    #                                  it divides serving.kv_bytes_per_slot
+    #                                  directly (DESIGN.md §20)
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """Effective K/V head count (== n_heads without GQA)."""
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        assert 1 <= kv <= self.n_heads and self.n_heads % kv == 0, (
+            f"n_kv_heads={kv} must divide n_heads={self.n_heads}")
+        return kv
 
     def flops_per_token(self) -> float:
         """Approximate training FLOPs per token (fwd+bwd ≈ 6*N params +
@@ -105,9 +121,16 @@ class TransformerConfig:
 # --------------------------------------------------------------------------- params
 
 def init_params(key, cfg: TransformerConfig) -> Params:
-    """Scaled-normal init; qkv packed (D, 3, H, Dh), out proj (H, Dh, D)."""
+    """Scaled-normal init; qkv packed (D, 3, H, Dh), out proj (H, Dh, D).
+
+    Under GQA (``cfg.kv_heads < n_heads``) the packed ``wqkv`` splits into
+    ``wq`` (D, H, Dh) and ``wkv`` (D, 2, Kv, Dh) — a DIFFERENT tree, so
+    key-presence dispatch in the forward paths is static at trace time;
+    the equal-heads tree (and its RNG draws) stays byte-identical to
+    every pre-GQA checkpoint."""
     pd = cfg.param_dtype
     d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    kv = cfg.kv_heads
     keys = jax.random.split(key, cfg.n_layers + 3)
 
     def norm(k, shape, scale):
@@ -116,9 +139,15 @@ def init_params(key, cfg: TransformerConfig) -> Params:
     layers = []
     for i in range(cfg.n_layers):
         lk = jax.random.split(keys[i], 4)
+        if kv == h:
+            qkv_leaves = {"wqkv": norm(lk[0], (d, 3, h, dh), d ** -0.5)}
+        else:
+            qk, kk = jax.random.split(lk[0])
+            qkv_leaves = {"wq": norm(qk, (d, h, dh), d ** -0.5),
+                          "wkv": norm(kk, (d, 2, kv, dh), d ** -0.5)}
         layers.append({
             "ln1_scale": jnp.ones((d,), pd), "ln1_bias": jnp.zeros((d,), pd),
-            "wqkv": norm(lk[0], (d, 3, h, dh), d ** -0.5),
+            **qkv_leaves,
             "wo": norm(lk[1], (h, dh, d), (h * dh) ** -0.5),
             "ln2_scale": jnp.ones((d,), pd), "ln2_bias": jnp.zeros((d,), pd),
             "w1": norm(lk[2], (d, f), d ** -0.5),
@@ -143,7 +172,11 @@ def param_specs(cfg: TransformerConfig) -> Params:
     replicated (sharded-embedding variants come with the ep axis later)."""
     layer = {
         "ln1_scale": P(), "ln1_bias": P(),
-        "wqkv": P(None, None, TP, None),
+        # GQA trees stay replicated: the shard-offset-aware head-group map
+        # tp would need is not implemented (asserted in _block), and GQA's
+        # payoff is serving-side cache bytes, not training-side tp
+        **({"wqkv": P(None, None, TP, None)} if cfg.kv_heads == cfg.n_heads
+           else {"wq": P(), "wkv": P()}),
         "wo": P(TP, None, None),
         "ln2_scale": P(), "ln2_bias": P(),
         "w1": P(None, TP), "b1": P(TP),
@@ -278,6 +311,33 @@ def ring_attention(q, k, v, *, n_sp: int, sp_axis: str | None, causal: bool,
     return out.astype(q.dtype)
 
 
+def _qkv_proj(lp, h, dt):
+    """Project normed activations ``h`` (..., D) to ``(q, k, v)`` heads.
+
+    Classic trees carry the packed ``wqkv`` and run the exact einsum the
+    pre-GQA code always did (the bitwise-parity path); GQA trees carry
+    ``wq``/``wkv`` and produce k/v with ``n_kv_heads`` heads.  The key
+    check is static at trace time (same idiom as ``w1_q`` in ``_ffn``)."""
+    if "wkv" in lp:
+        q = jnp.einsum("...d,dhe->...he", h.astype(dt), lp["wq"].astype(dt))
+        kv = jnp.einsum("...d,dshe->...she", h.astype(dt),
+                        lp["wkv"].astype(dt))
+        return q, kv[..., 0, :, :], kv[..., 1, :, :]
+    qkv = jnp.einsum("...d,dshe->...she", h.astype(dt), lp["wqkv"].astype(dt))
+    return qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+
+
+def repeat_kv_heads(x, n_rep: int):
+    """Head-group broadcast for GQA: repeat the K/V head axis (always
+    axis -2, for both (..., T, K, Dh) caches and (..., K, Dh) tokens) so
+    query head ``h`` reads shared head ``h // n_rep``.  ``n_rep == 1``
+    returns ``x`` untouched — the bitwise-parity guarantee for classic
+    trees."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
 def _ffn(lp, h, dt):
     """The FFN sublayer body on (..., D) activations — shared verbatim by
     the training ``_block`` and the incremental ``decode_step`` so the two
@@ -303,8 +363,14 @@ def _block(params, x, cfg: TransformerConfig, n_sp, sp_axis, tp_axis, t_local):
     h = _layernorm(x, params["ln1_scale"], params["ln1_bias"])
     if tp_axis:
         h = copy_to_tp(h, tp_axis)
-    qkv = jnp.einsum("btd,dshe->btshe", h.astype(dt), params["wqkv"].astype(dt))
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q, k, v = _qkv_proj(params, h, dt)
+    if k.shape[-2] != q.shape[-2]:
+        # GQA head-group broadcast before attention; under tp the local
+        # query heads would need a shard-offset-aware group map — not
+        # implemented, train GQA models without a tp axis
+        assert tp_axis is None, "GQA (n_kv_heads < n_heads) does not shard over tp"
+        k = repeat_kv_heads(k, q.shape[-2] // k.shape[-2])
+        v = repeat_kv_heads(v, q.shape[-2] // v.shape[-2])
     if cfg.attention != "ring" and n_sp == 1 and t_local % 128 == 0:
         # any registered ops/pallas attention candidate ("flash", "fused",
         # ...) resolves through the kernel registry; ring keeps its direct
@@ -414,9 +480,10 @@ def lm_head_loss(params, h, targets, cfg: TransformerConfig) -> jnp.ndarray:
 
 def init_decode_cache(cfg: TransformerConfig, batch: int = 1) -> list:
     """Per-layer K/V buffers for incremental decoding: each layer caches
-    ``(B, max_len, H, Dh)`` keys and values; positions beyond the current
-    one stay zero and are masked out of the softmax."""
-    shape = (batch, cfg.max_len, cfg.n_heads, cfg.head_dim)
+    ``(B, max_len, Kv, Dh)`` keys and values (``Kv = cfg.kv_heads``, ==
+    n_heads without GQA); positions beyond the current one stay zero and
+    are masked out of the softmax."""
+    shape = (batch, cfg.max_len, cfg.kv_heads, cfg.head_dim)
     return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
 
@@ -435,14 +502,17 @@ def _decode_attend(params, x, valid, write_kv, cfg: TransformerConfig,
     candidate's tolerance instead of bitwise parity."""
     dt = cfg.dtype
     scale = cfg.head_dim ** -0.5
+    n_rep = cfg.n_heads // cfg.kv_heads
     for li, lp in enumerate(params["layers"]):
         h = _layernorm(x, lp["ln1_scale"], lp["ln1_bias"])
-        qkv = jnp.einsum("bd,dshe->bshe", h.astype(dt), lp["wqkv"].astype(dt))
-        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]               # (N, H, Dh)
+        q, k, v = _qkv_proj(lp, h, dt)                          # (N, H|Kv, Dh)
         ck, cv = write_kv(li, k, v)
         if attend is not None:
             att = attend(li, q)
         else:
+            # GQA: broadcast the cached heads up to the query heads at the
+            # READ — the cache (and its bytes) stay at n_kv_heads
+            ck, cv = repeat_kv_heads(ck, n_rep), repeat_kv_heads(cv, n_rep)
             s = jnp.einsum("bhd,bthd->bht", q, ck,
                            preferred_element_type=jnp.float32) * scale
             s = jnp.where(valid[:, None, :], s, -jnp.inf)
@@ -511,12 +581,14 @@ def reset_cache_slots(cache, slot_mask) -> list:
 
 def init_paged_cache(cfg: TransformerConfig, num_pages: int,
                      page_size: int) -> list:
-    """Per-layer paged K/V pools: ``(num_pages, page_size, H, Dh)`` keys
+    """Per-layer paged K/V pools: ``(num_pages, page_size, Kv, Dh)`` keys
     and values shared by ALL serving slots, addressed through per-slot
     block tables instead of a dense per-slot row (DESIGN.md §17).  The
     caller typically sizes ``num_pages`` with one extra trash page whose
-    index is parked in the block-table rows of inactive slots."""
-    shape = (num_pages, page_size, cfg.n_heads, cfg.head_dim)
+    index is parked in the block-table rows of inactive slots.  For the
+    int8/fp8 storage twin see ``ops.pallas.kv_quant
+    .init_quantized_paged_cache`` (DESIGN.md §20)."""
+    shape = (num_pages, page_size, cfg.kv_heads, cfg.head_dim)
     return [{"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
             for _ in range(cfg.n_layers)]
 
@@ -524,10 +596,23 @@ def init_paged_cache(cfg: TransformerConfig, num_pages: int,
 def reset_cache_pages(pages, page_mask) -> list:
     """Zero the physical pages named by ``page_mask`` (P,) bool — the
     paged twin of :func:`reset_cache_slots`: eviction hygiene for pages
-    whose refcount just reached zero (never for aliased pages)."""
+    whose refcount just reached zero (never for aliased pages).  A
+    quantized pool (``k_scale`` present) additionally resets the wiped
+    pages' absmax scales to neutral, so the monotone per-page running max
+    restarts from real content for the next occupant."""
     def wipe(c):
         return jnp.where(page_mask[:, None, None, None], jnp.zeros_like(c), c)
-    return [{"k": wipe(c["k"]), "v": wipe(c["v"])} for c in pages]
+
+    out = []
+    for c in pages:
+        d = {"k": wipe(c["k"]), "v": wipe(c["v"])}
+        if "k_scale" in c:
+            from ..ops.pallas import kv_quant
+            s0 = jnp.float32(kv_quant.neutral_scale(c["k"].dtype))
+            for sk in ("k_scale", "v_scale"):
+                d[sk] = jnp.where(page_mask[:, None], s0, c[sk])
+        out.append(d)
+    return out
 
 
 def paged_flat_index(block_table, positions, page_size: int):
@@ -557,6 +642,49 @@ def gather_paged_kv(c, block_table, max_len: int):
     return c.reshape((-1,) + c.shape[2:])[flat]
 
 
+def gather_paged_layer(c, block_table, max_len: int, dtype):
+    """Logical ``(B, max_len, Kv, Dh)`` k and v views of ONE layer's page
+    pool dict ``c`` — quant-transparent: a float pool gathers exactly as
+    :func:`gather_paged_kv` always did (the §17 bitwise path), a
+    quantized pool (``k_scale`` present) dequantizes through its per-page
+    per-head absmax scales first.  Returns ``(k, v)`` in ``dtype``."""
+    if "k_scale" in c:
+        from ..ops.pallas import kv_quant
+        kf = kv_quant.dequantize_pool(c["k"], c["k_scale"], dtype)
+        vf = kv_quant.dequantize_pool(c["v"], c["v_scale"], dtype)
+    else:
+        kf, vf = c["k"], c["v"]
+    return (gather_paged_kv(kf, block_table, max_len),
+            gather_paged_kv(vf, block_table, max_len))
+
+
+def scatter_paged_layer(c, flat, k, v) -> dict:
+    """Commit token K/V rows ``k``/``v`` (N, Kv, Dh) at flat pool indices
+    ``flat`` (N,) into one layer's pool dict ``c`` (out-of-range indices
+    drop — the window paths' OOB sentinel).  Float pools scatter exactly
+    as before; quantized pools quantize AT THE WRITE (DESIGN.md §20):
+    dequantize → scatter → requantize against monotone per-page per-head
+    absmax scales, so untouched pages round-trip byte-identically and
+    only the written page can re-round.  This jnp path is the parity
+    REFERENCE; the streamed ``paged_attention_int8`` kernel is the perf
+    path behind the autopick gate."""
+    if "k_scale" not in c:
+        return {
+            key: c[key].reshape((-1,) + c[key].shape[2:]).at[flat].set(
+                val, mode="drop").reshape(c[key].shape)
+            for key, val in (("k", k), ("v", v))}
+    from ..ops.pallas import kv_quant
+    out = {}
+    for key, val in (("k", k), ("v", v)):
+        skey = key + "_scale"
+        f = kv_quant.dequantize_pool(c[key], c[skey], jnp.float32)
+        f = f.reshape((-1,) + f.shape[2:]).at[flat].set(
+            val.astype(jnp.float32), mode="drop").reshape(f.shape)
+        out[key], out[skey] = kv_quant.requantize_pool(
+            f, c[skey], c[key].dtype)
+    return out
+
+
 def decode_step_paged(params, pages, block_tables, tokens, pos,
                       cfg: TransformerConfig, attn_fn=None):
     """Paged twin of :func:`decode_step`: K/V live in the shared page
@@ -565,11 +693,15 @@ def decode_step_paged(params, pages, block_tables, tokens, pos,
     write-then-read order as the dense path), then attention runs over a
     gather of the row's logical ``[0, max_len)`` K/V — an exactly
     ``(B, max_len)`` buffer through :func:`_decode_attend`, so logits are
-    bitwise ``decode_step``'s given equal cache content.  ``attn_fn``
-    optionally swaps the gather+softmax read for a registry candidate
-    ``(q, k_pages, v_pages, block_tables, lengths) -> (B, H, Dh)`` (the
-    bench-autopick perf path; numerics then carry that candidate's
-    tolerance).  Returns ``(logits (B, V) f32, new_pages)``."""
+    bitwise ``decode_step``'s given equal cache content.  Quantized pools
+    (``k_scale`` present) quantize-at-write and dequantize-at-read
+    through :func:`scatter_paged_layer`/:func:`gather_paged_layer`;
+    numerics then carry the int8-KV agreement tolerance instead of
+    bitwise parity.  ``attn_fn`` optionally swaps the gather+softmax read
+    for a registry candidate ``(q, k_pages, v_pages, block_tables,
+    lengths) -> (B, H, Dh)`` (``(q, k_pages, v_pages, k_scale, v_scale,
+    block_tables, lengths)`` for quantized pools — the bench-autopick
+    perf path).  Returns ``(logits (B, V) f32, new_pages)``."""
     dt = cfg.dtype
     ps = pages[0]["k"].shape[1]
     pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), tokens.shape)  # (B,)
@@ -580,23 +712,22 @@ def decode_step_paged(params, pages, block_tables, tokens, pos,
     new_pages: list = []
 
     def write_kv(li, k, v):
-        c = pages[li]
-        pk = c["k"].reshape((-1,) + c["k"].shape[2:]).at[flat].set(
-            k).reshape(c["k"].shape)
-        pv = c["v"].reshape((-1,) + c["v"].shape[2:]).at[flat].set(
-            v).reshape(c["v"].shape)
-        new_pages.append({"k": pk, "v": pv})
+        c2 = scatter_paged_layer(pages[li], flat, k, v)
+        new_pages.append(c2)
         if attn_fn is not None:
-            return pk, pv
-        ck = gather_paged_kv(pk, block_tables, cfg.max_len)
-        cv = gather_paged_kv(pv, block_tables, cfg.max_len)
-        return ck, cv
+            return None, None  # the attend hook reads new_pages directly
+        return gather_paged_layer(c2, block_tables, cfg.max_len, dt)
 
     attend = None
     if attn_fn is not None:
         def attend(li, q):
-            pk, pv = new_pages[li]["k"], new_pages[li]["v"]
-            return attn_fn(q, pk, pv, block_tables, pos_b + 1).astype(dt)
+            c2 = new_pages[li]
+            if "k_scale" in c2:
+                return attn_fn(q, c2["k"], c2["v"], c2["k_scale"],
+                               c2["v_scale"], block_tables,
+                               pos_b + 1).astype(dt)
+            return attn_fn(q, c2["k"], c2["v"], block_tables,
+                           pos_b + 1).astype(dt)
 
     logits = _decode_attend(params, x, valid, write_kv, cfg, attend=attend)
     return logits, new_pages
@@ -673,14 +804,9 @@ def decode_window_paged(params, pages, block_tables, tokens, pos,
     new_pages: list = []
 
     def write_kv(li, k, v):
-        c = pages[li]
-        pk = c["k"].reshape((-1,) + c["k"].shape[2:]).at[flat].set(
-            k, mode="drop").reshape(c["k"].shape)
-        pv = c["v"].reshape((-1,) + c["v"].shape[2:]).at[flat].set(
-            v, mode="drop").reshape(c["v"].shape)
-        new_pages.append({"k": pk, "v": pv})
-        ck = gather_paged_kv(pk, block_tables, T)
-        cv = gather_paged_kv(pv, block_tables, T)
+        c2 = scatter_paged_layer(pages[li], flat, k, v)
+        new_pages.append(c2)
+        ck, cv = gather_paged_layer(c2, block_tables, T, dt)
         ck2 = jnp.broadcast_to(ck[:, None], (B, W) + ck.shape[1:]).reshape(
             (B * W,) + ck.shape[1:])
         cv2 = jnp.broadcast_to(cv[:, None], (B, W) + cv.shape[1:]).reshape(

@@ -63,9 +63,10 @@ from jax import lax
 from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..models.transformer import (decode_step, decode_step_paged,
                                   decode_window, decode_window_paged,
-                                  gather_paged_kv, init_decode_cache,
+                                  gather_paged_layer, init_decode_cache,
                                   init_paged_cache, paged_flat_index,
-                                  reset_cache_pages, reset_cache_slots)
+                                  reset_cache_pages, reset_cache_slots,
+                                  scatter_paged_layer)
 from ..observability import COSTS, FLIGHTREC, METRICS, trace
 from ..observability.core import enabled as _obs_enabled
 from ..parallel.checkpoint import CheckpointManager
@@ -105,6 +106,29 @@ class ServingConfig:
     paged_attention_impl: str = "gather"  # "gather" (jnp, bitwise) or a
     #                                 registry candidate name — only adopt a
     #                                 kernel through the bench autopick gate
+    kv_quant: str | None = None     # KV-page storage precision (DESIGN.md
+    #                                 §20): None = model dtype (bitwise),
+    #                                 "int8" = per-page per-head absmax int8
+    #                                 (~4x pool capacity, ≥0.999 token top-1
+    #                                 agreement), "fp8" = float8 storage on
+    #                                 jax builds that have it (gated off by
+    #                                 default like every quant tier).
+    #                                 Requires paged=True.
+
+
+def kv_page_bytes(mcfg, page_size: int, kv_quant: str | None = None) -> int:
+    """Device bytes one KV page costs under the given storage mode — the
+    accounting behind ``serving.kv_bytes*`` and the capacity planning in
+    ``tools/metrics_dump.py``.  Counts K+V data across all layers at the
+    storage itemsize (1 for int8/fp8) plus, when quantized, the per-page
+    per-kv-head f32 absmax scales stored beside the pool."""
+    from ..ops.pallas import kv_quant as kvq
+    kvh = mcfg.kv_heads
+    item = kvq.kv_itemsize(kv_quant, mcfg.dtype)
+    per_layer = page_size * kvh * mcfg.head_dim * 2 * item
+    if kv_quant is not None:
+        per_layer += 2 * kvh * 4   # k_scale + v_scale rows, f32
+    return per_layer * mcfg.n_layers
 
 
 @dataclasses.dataclass
@@ -139,6 +163,12 @@ class InferenceEngine:
         if cfg.prefix_cache and not cfg.paged:
             raise ValueError("prefix_cache requires paged=True (sharing is "
                              "block-table aliasing)")
+        if cfg.kv_quant is not None:
+            if not cfg.paged:
+                raise ValueError("kv_quant requires paged=True (scales live "
+                                 "beside the page pool)")
+            from ..ops.pallas import kv_quant as kvq
+            kvq.storage_dtype(cfg.kv_quant)  # validates mode / fp8 support
         if cfg.speculative:
             if draft_model is None or draft_params is None:
                 raise ValueError("speculative=True needs draft_model and "
@@ -161,9 +191,8 @@ class InferenceEngine:
                            else cfg.slots * self._pages_per_slot)
         self._pool = (PagePool(self._num_pages, cfg.page_size)
                       if cfg.paged else None)
-        mcfg = model.cfg
-        self._page_bytes = (cfg.page_size * mcfg.n_heads * mcfg.head_dim
-                            * 2 * mcfg.n_layers * jnp.dtype(mcfg.dtype).itemsize)
+        self._page_bytes = kv_page_bytes(model.cfg, cfg.page_size,
+                                         cfg.kv_quant)
         self._queue = RequestQueue(cfg.max_queue, cfg.max_batch_delay_ms)
         self._ckpt: CheckpointManager | None = None
         self._loaded_step: int | None = None
@@ -241,8 +270,14 @@ class InferenceEngine:
         if self.cfg.paged:
             # +1 physical page: the trash page every inactive block-table
             # row points at, so masked writes never land on real pages
-            state["pages"] = init_paged_cache(
-                cfg, self._num_pages + 1, self._page_size)
+            if self.cfg.kv_quant is not None:
+                from ..ops.pallas.kv_quant import init_quantized_paged_cache
+                state["pages"] = init_quantized_paged_cache(
+                    cfg, self._num_pages + 1, self._page_size,
+                    self.cfg.kv_quant)
+            else:
+                state["pages"] = init_paged_cache(
+                    cfg, self._num_pages + 1, self._page_size)
             state["bt"] = jnp.full((S, self._pages_per_slot),
                                    self._num_pages, jnp.int32)
         else:
@@ -260,7 +295,9 @@ class InferenceEngine:
         if impl == "gather":
             return None
         from ..ops.pallas import registry as kernel_registry
-        return kernel_registry.get("paged_attention", impl).fn
+        kind = ("paged_attention_int8" if self.cfg.kv_quant is not None
+                else "paged_attention")
+        return kernel_registry.get(kind, impl).fn
 
     def _build_step(self) -> Callable:
         if self.cfg.speculative:
@@ -446,8 +483,11 @@ class InferenceEngine:
             if paged:
                 bt_row = lax.dynamic_slice(
                     state["bt"], (slot, jnp.int32(0)), (1, n_slot_pages))
-                cache1 = [{"k": gather_paged_kv(c["k"], bt_row, cfg.max_len),
-                           "v": gather_paged_kv(c["v"], bt_row, cfg.max_len)}
+                # quant-transparent: a quantized pool (kv_quant) gathers
+                # DEQUANTIZED content, so the prefill loop below runs the
+                # same float arithmetic either way
+                cache1 = [dict(zip(("k", "v"), gather_paged_layer(
+                    c, bt_row, cfg.max_len, cfg.dtype)))
                           for c in state["pages"]]
             else:
                 cache1 = init_decode_cache(cfg, 1)
@@ -473,11 +513,11 @@ class InferenceEngine:
             if paged:
                 t = jnp.arange(cfg.max_len, dtype=jnp.int32)[None, :]
                 flat = paged_flat_index(bt_row, t, ps)[0]        # (max_len,)
+                # quantize-at-write for kv_quant pools (scatter_paged_layer
+                # requantizes only the row's pages; an aliased prefix page
+                # rewrites with identical content → identical bytes)
                 kv_update = {"pages": [
-                    {"k": c["k"].reshape((-1,) + c["k"].shape[2:])
-                          .at[flat].set(c1["k"][0]).reshape(c["k"].shape),
-                     "v": c["v"].reshape((-1,) + c["v"].shape[2:])
-                          .at[flat].set(c1["v"][0]).reshape(c["v"].shape)}
+                    scatter_paged_layer(c, flat, c1["k"][0], c1["v"][0])
                     for c, c1 in zip(state["pages"], cache1)]}
             else:
                 kv_update = {"cache": [
@@ -908,9 +948,12 @@ class InferenceEngine:
         """Device-KV footprint gauges at admission/eviction fences: pages
         in use (shared pages count ONCE — that is the point), bytes, and
         bytes per occupied slot vs the dense ``S*max_len`` baseline."""
+        from ..ops.pallas.kv_quant import kv_itemsize
+        mcfg = self.model.cfg
+        bits = kv_itemsize(self.cfg.kv_quant, mcfg.dtype) * 8
+        METRICS.gauge("serving.kv_quant_bits", bits)
         if self._pool is None:
-            mcfg = self.model.cfg
-            dense = (mcfg.max_len * mcfg.n_heads * mcfg.head_dim * 2
+            dense = (mcfg.max_len * mcfg.kv_heads * mcfg.head_dim * 2
                      * mcfg.n_layers * jnp.dtype(mcfg.dtype).itemsize)
             METRICS.gauge("serving.kv_bytes", dense * self.cfg.slots)
             METRICS.gauge("serving.kv_bytes_per_slot", dense)
@@ -922,6 +965,8 @@ class InferenceEngine:
             for pages in self._slot_pages.values():
                 slot_pages.update(pages)
         METRICS.gauge("serving.kv_pages_in_use", in_use)
+        METRICS.gauge("serving.kv_pages_total", self._num_pages)
+        METRICS.gauge("serving.kv_page_bytes", self._page_bytes)
         METRICS.gauge("serving.prefix_hit_rate", self._pool.hit_rate())
         METRICS.gauge("serving.kv_bytes", in_use * self._page_bytes)
         # per-slot cost counts pages *referenced by occupied slots* once
@@ -1133,6 +1178,8 @@ class InferenceEngine:
             }
         if self._pool is not None:
             out["kv_pages"] = self._num_pages
+            out["kv_quant"] = self.cfg.kv_quant
+            out["kv_page_bytes"] = self._page_bytes
             out["kv_pages_in_use"] = self._pool.in_use()
             out["prefix_entries"] = self._pool.prefix_entries()
             out["prefix_hit_rate"] = self._pool.hit_rate()

@@ -1,11 +1,25 @@
 """Host-side bookkeeping for the paged KV cache (DESIGN.md §17).
 
 The device side is dumb on purpose: per-layer ``(num_pages, page_size,
-H, Dh)`` pools plus an ``(S, pages_per_slot)`` int32 block table, both
-living in the engine's donated decode state.  Everything stateful —
-free-list, per-page refcounts, the content-addressed prefix cache —
-lives HERE, on the host, under one lock, so the decode hot loop never
-synchronizes on allocation metadata.
+Kv, Dh)`` pools (``Kv = n_kv_heads`` under GQA) plus an
+``(S, pages_per_slot)`` int32 block table, both living in the engine's
+donated decode state.  Under ``kv_quant`` the pools are int8/fp8 with a
+``(num_pages, Kv)`` f32 scale row per page riding beside them
+(``ops/pallas/kv_quant.py``) — still addressed by the SAME page ids
+this pool hands out, so nothing here changes: a page is a page.
+Everything stateful — free-list, per-page refcounts, the
+content-addressed prefix cache — lives HERE, on the host, under one
+lock, so the decode hot loop never synchronizes on allocation metadata.
+
+Quantization does lean on two pool-adjacent invariants, recorded here
+because this module's lifecycle is what makes them safe: (1) prefix
+sharing stays sound because quantized rewrites of identical content are
+byte-identical (monotone per-page scales — see ``kv_quant``), so an
+aliased page's bytes never depend on WHICH slot wrote them; (2) the
+:meth:`clear_prefix` quarantine → wipe → :meth:`requeue` reload path
+must reset page SCALES along with page content (``reset_cache_pages``
+does both), or a stale scale would leak a superseded occupant's
+magnitude into the next tenant's precision.
 
 Prefix cache: content addressing is a chained hash over FULL token
 pages — ``h_k = H(h_{k-1} || tokens[(k-1)*ps : k*ps])`` — so a lookup

@@ -124,6 +124,7 @@ def test_committed_artifact_is_marked_stale():
 def test_kernel_picks_table_covers_every_kind():
     table = bench._kernel_picks()
     assert set(table) == {"attention", "layernorm_residual", "xent",
-                          "int8_matmul", "paged_attention"}
+                          "int8_matmul", "paged_attention",
+                          "paged_attention_int8"}
     for kind, pick in table.items():
         assert "choice" in pick and "dropped" in pick, kind

@@ -268,3 +268,32 @@ def test_metrics_dump_renders_serving_and_utilization_tables():
     empty = {"counters": {}, "gauges": {}, "timers": {}}
     assert render_serving(empty) is None
     assert render_utilization(empty) is None
+
+
+def test_metrics_dump_renders_kv_capacity_table():
+    """The users-per-chip table (DESIGN.md §20): derived rows — pool
+    bytes, bytes per slot, slots per pool — from the kv gauges; absent
+    gauges mean no table, not a crash."""
+    from tools.metrics_dump import render_kv_capacity
+
+    snap = {
+        "counters": {},
+        "gauges": {
+            "serving.kv_quant_bits": 8.0,
+            "serving.kv_pages_total": 64.0,
+            "serving.kv_page_bytes": 576.0,
+            "serving.kv_pages_in_use": 16.0,
+            "serving.kv_bytes_per_slot": 4032.0,
+        },
+        "timers": {},
+    }
+    table = render_kv_capacity(snap)
+    assert "kv_storage_bits" in table and "8" in table
+    assert "pool_pages" in table and "64" in table
+    assert "slots_per_pool" in table
+    # pool_bytes = page_bytes * pages_total = 36864 -> 36.00KiB
+    assert "36.00KiB" in table
+    # slots = pool_bytes // bytes_per_slot = 9
+    assert "9" in table
+    empty = {"counters": {}, "gauges": {}, "timers": {}}
+    assert render_kv_capacity(empty) is None

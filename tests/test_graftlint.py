@@ -834,3 +834,68 @@ def test_ob01_quiet_on_clock_without_dispatch():
     """
     assert not lint(src, only="OB01",
                     path="deeplearning4j_tpu/serving/snippet.py")
+
+
+# --------------------------------------------------------------------------- QT01
+
+QT01_BAD = """
+    import jax.numpy as jnp
+
+    def pack(kv):
+        return kv.astype(jnp.int8)
+"""
+
+QT01_BAD_FP8 = """
+    import jax.numpy as jnp
+
+    def pack(kv):
+        return kv.astype(jnp.float8_e4m3fn)
+"""
+
+QT01_BAD_KWARG = """
+    import jax.numpy as jnp
+
+    def pack(kv):
+        return kv.astype(dtype=jnp.int8)
+"""
+
+QT01_GOOD = """
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops.pallas.kv_quant import cast_to
+
+    def pack(kv, scale):
+        return cast_to(kv / scale, jnp.int8)
+"""
+
+
+def test_qt01_fires_on_raw_int8_cast_in_serving():
+    findings = lint(QT01_BAD, only="QT01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+    assert rules_hit(findings) == {"QT01"}
+
+
+def test_qt01_fires_on_fp8_and_dtype_kwarg_in_models():
+    for src in (QT01_BAD_FP8, QT01_BAD_KWARG):
+        findings = lint(src, only="QT01",
+                        path="deeplearning4j_tpu/models/snippet.py")
+        assert rules_hit(findings) == {"QT01"}
+
+
+def test_qt01_quiet_outside_serving_and_models():
+    """The quant helpers themselves (ops/pallas) hold the one allowed
+    raw cast — the rule scopes to the consumer trees."""
+    assert not lint(QT01_BAD, only="QT01",
+                    path="deeplearning4j_tpu/ops/pallas/kv_quant.py")
+
+
+def test_qt01_quiet_on_helper_and_float_casts():
+    assert not lint(QT01_GOOD, only="QT01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+    src = """
+        import jax.numpy as jnp
+
+        def widen(x):
+            return x.astype(jnp.float32)
+    """
+    assert not lint(src, only="QT01",
+                    path="deeplearning4j_tpu/serving/snippet.py")

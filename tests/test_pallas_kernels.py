@@ -45,7 +45,7 @@ def test_registry_kinds_and_candidates_complete():
     assert registry.import_errors() == {}
     assert registry.kinds() == ["attention", "int8_matmul",
                                 "layernorm_residual", "paged_attention",
-                                "xent"]
+                                "paged_attention_int8", "xent"]
     assert [c.name for c in registry.candidates("attention")] == [
         "flash", "fused", "ring"]
     # every pallas candidate ships a reference and documented tolerances
@@ -451,3 +451,106 @@ def test_paged_attention_registered_behind_autopick_gate():
     rows[-1]["tokens_per_sec"] = 150.0
     pick = registry.autopick("paged_attention", rows, incumbent="gather")
     assert pick.choice == "pallas"       # evidence + margin: adopted
+
+
+# ------------------------------------------------ paged attention: GQA + int8
+
+@pytest.mark.parametrize("n_kv", [1, 2, 4])
+def test_paged_attention_gqa_parity(n_kv):
+    """Kernel vs reference when pages carry fewer K/V heads than query
+    heads (H=4, Kv in {1, 2, 4}): the in-register head-group broadcast
+    must match the gather reference's repeat-heads path."""
+    from deeplearning4j_tpu.ops.pallas.paged_attention import (
+        paged_attention, reference_paged_attention)
+    B, H, D, ps, n_pages = 3, 4, 16, 5, 4
+    rng = np.random.default_rng(11)
+    n_phys = B * n_pages + 1
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((n_phys, ps, n_kv, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((n_phys, ps, n_kv, D)), jnp.float32)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[: B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    lengths = jnp.asarray([1, ps + 2, n_pages * ps], jnp.int32)
+    out = paged_attention(q, k, v, bt, lengths)
+    want = reference_paged_attention(q, k, v, bt, lengths)
+    _close(out, want, jnp.float32)
+
+
+def _paged_int8_case(n_kv=4, B=3, H=4, D=16, ps=5, n_pages=4, seed=0):
+    from deeplearning4j_tpu.ops.pallas import kv_quant
+    from deeplearning4j_tpu.ops.pallas.paged_attention import (
+        paged_attention_int8, reference_paged_attention_int8)
+    rng = np.random.default_rng(seed)
+    n_phys = B * n_pages + 1
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((n_phys, ps, n_kv, D)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((n_phys, ps, n_kv, D)), jnp.float32)
+    s0 = jnp.full((n_phys, n_kv), kv_quant.neutral_scale(jnp.int8))
+    k, ks = kv_quant.requantize_pool(kf, s0, jnp.int8)
+    v, vs = kv_quant.requantize_pool(vf, s0, jnp.int8)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[: B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    lengths = jnp.asarray([1, ps + 2, n_pages * ps], jnp.int32)[:B]
+    return (paged_attention_int8, reference_paged_attention_int8,
+            (q, k, v, ks, vs, bt, lengths))
+
+
+@pytest.mark.parametrize("n_kv", [2, 4])
+def test_paged_attention_int8_kernel_matches_reference(n_kv):
+    """The in-kernel per-page dequantize (interpret mode, so the real
+    kernel body runs on CPU) must match the dequantize-whole-pool jnp
+    reference — which IS the engine's quantized parity path."""
+    fn, ref, args = _paged_int8_case(n_kv=n_kv)
+    out = fn(*args)
+    want = ref(*args)
+    assert out.dtype == args[0].dtype
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_paged_attention_int8_tracks_float_within_quant_band():
+    """Quantize-then-attend stays inside the kind's registered numeric
+    band (max_err 0.05) of full-precision attention over the ORIGINAL
+    float pool content — the error budget autopick holds it to."""
+    from deeplearning4j_tpu.ops.pallas import kv_quant
+    from deeplearning4j_tpu.ops.pallas.paged_attention import (
+        reference_paged_attention, reference_paged_attention_int8)
+    B, H, D, ps, n_pages = 3, 4, 16, 5, 4
+    rng = np.random.default_rng(5)
+    n_phys = B * n_pages + 1
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kf = jnp.asarray(rng.standard_normal((n_phys, ps, H, D)), jnp.float32)
+    vf = jnp.asarray(rng.standard_normal((n_phys, ps, H, D)), jnp.float32)
+    s0 = jnp.full((n_phys, H), kv_quant.neutral_scale(jnp.int8))
+    k, ks = kv_quant.requantize_pool(kf, s0, jnp.int8)
+    v, vs = kv_quant.requantize_pool(vf, s0, jnp.int8)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[: B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    lengths = jnp.asarray([1, ps + 2, n_pages * ps], jnp.int32)
+    a = reference_paged_attention_int8(q, k, v, ks, vs, bt, lengths)
+    b = reference_paged_attention(q, kf, vf, bt, lengths)
+    assert float(jnp.max(jnp.abs(a - b))) < 0.05
+
+
+def test_paged_attention_int8_gate_needs_agreement_floor():
+    """int8 KV adoption requires the top-1 agreement floor on top of
+    margin + max_err — a fast kernel that flips tokens stays dropped."""
+    cand = registry.get("paged_attention_int8", "pallas_int8")
+    inc = registry.get("paged_attention_int8", "gather_int8")
+    assert inc.source == "xla"
+    assert cand.tolerances["min"]["top1_agree"] == 0.999
+    rows = [
+        {"kernel": "paged_attention_int8", "candidate": "gather_int8",
+         "tokens_per_sec": 100.0},
+        {"kernel": "paged_attention_int8", "candidate": "pallas_int8",
+         "check": {"max_err": 0.001, "top1_agree": 0.99}},   # below floor
+        {"kernel": "paged_attention_int8", "candidate": "pallas_int8",
+         "tokens_per_sec": 200.0},
+    ]
+    pick = registry.autopick("paged_attention_int8", rows,
+                             incumbent="gather_int8")
+    assert pick.choice == "gather_int8"
+    rows[1]["check"]["top1_agree"] = 1.0
+    pick = registry.autopick("paged_attention_int8", rows,
+                             incumbent="gather_int8")
+    assert pick.choice == "pallas_int8"

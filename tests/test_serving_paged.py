@@ -451,6 +451,213 @@ def test_warmup_and_serving_with_pool_smaller_than_max_len(lm):
     assert engine._pool.free_count() == engine._pool.num_pages
 
 
+# ---------------------------------------------------------------- kv quant
+QPLANS = [([5, 1, 4], 6, 0.0, 0),            # greedy-only: the agreement
+          ([7], 5, 0.0, 3),                  # floor is a top-1 statistic
+          ([3, 2, 1, 0, 5], 6, 0.0, 9),
+          ([3, 1, 4, 1], 8, 0.0, 1)]
+
+
+def _agreement(got, want):
+    hits = total = 0
+    for g, w in zip(got, want):
+        for a, b in zip(g, w):
+            total += 1
+            hits += int(a == b)
+    return total, hits / max(total, 1)
+
+
+def _train_decisive(cfg, period, seed=0, steps=60):
+    """A briefly-trained model: decisive top-2 logit margins so the 0.999
+    agreement floor measures QUANTIZATION error, not argmax coin flips on
+    a random init's near-flat logits."""
+    from deeplearning4j_tpu.optimize import transforms as T
+    stream = np.array(period * 32, np.int32) % cfg.vocab_size
+    span = cfg.max_len + 1
+    n = len(stream) // span
+    blocks = stream[:n * span].reshape(n, span)
+    model = TransformerLM(cfg)
+    tx = T.adamw(0.01)
+    params = model.init(jax.random.key(seed))
+    opt = model.init_opt(params, tx)
+    step = model.build_train_step(tx)
+    toks, tgts = jnp.asarray(blocks[:, :-1]), jnp.asarray(blocks[:, 1:])
+    for _ in range(steps):
+        params, opt, _ = step(params, opt, toks, tgts)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def sharp_lm():
+    return _train_decisive(tiny_cfg(vocab_size=16), [3, 1, 4, 1, 5, 9, 2, 6])
+
+
+def test_kv_quant_none_stays_bitwise(lm):
+    """``kv_quant=None`` is the default AND the exact path: the float
+    pool serves bitwise-identical tokens — quantization is strictly
+    opt-in, never a silent precision change."""
+    model, params = lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in PLANS]
+    _, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=3, resolve_every=2, paged=True, page_size=5,
+                      prefix_cache=True, kv_quant=None))
+    assert got == want
+
+
+def test_kv_quant_requires_paged(lm):
+    """Scales live beside the page pool; a dense cache has nowhere to put
+    them, so the engine refuses the combination at construction."""
+    model, params = lm
+    with pytest.raises(ValueError, match="paged"):
+        InferenceEngine(model, params=params,
+                        cfg=ServingConfig(slots=2, kv_quant="int8"))
+    with pytest.raises(ValueError, match="kv_quant"):
+        InferenceEngine(model, params=params,
+                        cfg=ServingConfig(slots=2, paged=True, page_size=4,
+                                          kv_quant="int4"))
+
+
+def test_int8_kv_greedy_agreement_meets_floor(sharp_lm):
+    """The tentpole's serving bar: int8 KV pages keep served-token top-1
+    agreement >= 0.999 against the full-precision offline sample."""
+    model, params = sharp_lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in QPLANS]
+    engine, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=3, resolve_every=2, paged=True, page_size=5,
+                      prefix_cache=True, kv_quant="int8"),
+        plans=QPLANS)
+    total, agree = _agreement(got, want)
+    assert total >= 20
+    assert agree >= 0.999, f"top-1 agreement {agree} under the floor"
+    assert engine.stats()["kv_quant"] == "int8"
+    # the quantized pool drains like the float pool: no page leaked
+    pinned = engine._pool.in_use()
+    assert engine._pool.free_count() == engine._pool.num_pages - pinned
+
+
+def test_int8_kv_speculative_agreement_meets_floor(sharp_lm):
+    """Draft-verify windows run over the SAME quantized pool (the window
+    gather dequantizes, the scatter requantizes): the combined
+    paged+prefix+speculative int8 stack holds the agreement floor."""
+    model, params = sharp_lm
+    want = [_expected(model, params, p, n, t, s) for p, n, t, s in QPLANS]
+    _, got = _serve_plans(
+        model, params,
+        ServingConfig(slots=3, resolve_every=2, paged=True, page_size=5,
+                      prefix_cache=True, speculative=True, spec_k=2,
+                      kv_quant="int8"),
+        plans=QPLANS, draft_model=model, draft_params=params)
+    total, agree = _agreement(got, want)
+    assert total >= 20
+    assert agree >= 0.999, f"top-1 agreement {agree} under the floor"
+
+
+def test_int8_kv_decode_tracks_dense_within_quant_band():
+    """Unit-level combo check (GQA x int8): the quantized paged step's
+    logits track the dense float step within the absmax quantization
+    band at every position — error stays bounded, it does not compound
+    across incremental writes."""
+    from deeplearning4j_tpu.models.transformer import decode_step_paged
+    from deeplearning4j_tpu.ops.pallas.kv_quant import \
+        init_quantized_paged_cache
+    cfg = tiny_cfg(n_kv_heads=2)
+    params = TransformerLM(cfg).init(jax.random.key(0))
+    B, ps = 2, 5
+    n_pages = -(-cfg.max_len // ps)
+    n_phys = B * n_pages + 1
+    rng = np.random.default_rng(4)
+    bt = jnp.asarray(rng.permutation(n_phys - 1)[:B * n_pages]
+                     .reshape(B, n_pages), jnp.int32)
+    dense = init_decode_cache(cfg, B)
+    pages = init_quantized_paged_cache(cfg, n_phys, ps, "int8")
+    assert pages[0]["k"].dtype == jnp.int8
+    assert pages[0]["k"].shape[2] == 2            # GQA-sized pool
+    for i in range(12):
+        tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (B,)), jnp.int32)
+        pos = jnp.full((B,), i, jnp.int32)
+        ld, dense = decode_step(params, dense, tok, pos, cfg)
+        lp, pages = decode_step_paged(params, pages, bt, tok, pos, cfg)
+        err = float(jnp.max(jnp.abs(ld - lp)))
+        assert err < 0.05, f"step {i}: logit error {err} out of quant band"
+
+
+def test_reload_invalidates_quantized_prefix_pages(tmp_path):
+    """Hot-swap with a quantized pool: cached prefix chains hold int8
+    pages AND their scale rows computed under the OLD weights — reload
+    must drop every chain (entries -> 0) and post-reload shared-prefix
+    traffic must track the NEW params, re-learning the cache."""
+    model, params_old = _train_decisive(tiny_cfg(vocab_size=16),
+                                        [3, 1, 4, 1, 5, 9, 2, 6])
+    _, params_new = _train_decisive(tiny_cfg(vocab_size=16),
+                                    [2, 7, 1, 8, 2, 8, 1, 8], seed=11)
+    mgr = CheckpointManager(tmp_path / "ck", keep=3)
+    mgr.save(1, params_old)
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+    plans = [(sys_prompt + [t], 4, 0.0, 11 + t) for t in (1, 2)]
+    engine = InferenceEngine(
+        model, checkpoint=str(tmp_path / "ck"),
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=4,
+                          prefix_cache=True, kv_quant="int8"))
+    with engine:
+        got = [engine.generate(p, n, temperature=t, seed=s, timeout=120.0)
+               .tokens for p, n, t, s in plans]
+        want = [_expected(model, params_old, p, n, t, s)
+                for p, n, t, s in plans]
+        assert _agreement(got, want)[1] >= 0.999
+        assert engine.stats()["prefix_entries"] > 0
+        mgr.save(2, params_new)
+        assert engine.reload() == 2
+        assert engine.stats()["prefix_entries"] == 0   # old-weight chains gone
+        got = [engine.generate(p, n, temperature=t, seed=s, timeout=120.0)
+               .tokens for p, n, t, s in plans]
+        want = [_expected(model, params_new, p, n, t, s)
+                for p, n, t, s in plans]
+        assert _agreement(got, want)[1] >= 0.999, \
+            "post-reload tokens do not track the NEW weights"
+        assert engine.stats()["prefix_entries"] > 0    # re-learned
+    pinned = engine._pool.in_use()
+    assert engine._pool.free_count() == engine._pool.num_pages - pinned
+
+
+def test_int8_pages_stretch_the_byte_budget(lm):
+    """The capacity claim, engine-level: under a FIXED device-byte budget
+    the int8 pool admits the concurrent request the float pool 429s —
+    and the per-page byte accounting shows >= 1.9x pages (<= 0.53x bytes
+    per slot) for the same geometry."""
+    from deeplearning4j_tpu.serving.engine import kv_page_bytes
+    model, params = lm
+    cfg = model.cfg
+    ps = 4
+    float_page = kv_page_bytes(cfg, ps, None)
+    int8_page = kv_page_bytes(cfg, ps, "int8")
+    assert float_page / int8_page >= 1.9
+    assert int8_page / float_page <= 0.53
+    budget = 9 * float_page                    # 9 float pages: 2 long
+    #                                            requests do NOT fit (the
+    #                                            429 test above proves it)
+    pages_int8 = budget // int8_page
+    assert pages_int8 >= 1.9 * 9
+    prompt, n_new = [1] * 20, 8                # 7 pages each
+    exhausted_before = METRICS.snapshot()["counters"].get(
+        "serving.page_pool_exhausted", 0)
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=2, resolve_every=2, paged=True, page_size=ps,
+                          num_pages=int(pages_int8), kv_quant="int8"))
+    h1 = engine.submit(prompt, n_new, seed=7)
+    h2 = engine.submit(prompt, n_new, seed=7)
+    with engine:
+        r1 = h1.result(120.0)                  # neither request 429s: the
+        r2 = h2.result(120.0)                  # budget now holds both
+    assert len(r1.tokens) == n_new and len(r2.tokens) == n_new
+    assert r1.tokens == r2.tokens              # same seed, same stream
+    assert engine._pool.free_count() == engine._pool.num_pages
+    assert METRICS.snapshot()["counters"].get(
+        "serving.page_pool_exhausted", 0) == exhausted_before
+
+
 # ------------------------------------------------------------------ wakeup
 def test_cv_wakeup_beats_idle_poll(lm):
     """The batcher's condition-variable wakeup: with a pathological

@@ -149,12 +149,18 @@ def run(seed: int | None = None, zero_stage: int = 0) -> dict:
     return result
 
 
-def run_serving(seed: int) -> dict:
+def run_serving(seed: int, kv_quant: str | None = None) -> dict:
     """Chaos leg for the serving subsystem: fire ``serving.request`` at a
     random submit index and ``serving.decode`` for a random number of
     decode rounds, and assert every completion is STILL token-identical
     to the fault-free ``Transformer.sample`` reference — the engine's
     skip-and-retry contract (a skipped dispatch leaves state untouched).
+
+    With ``kv_quant`` set the same dice roll runs against a paged +
+    prefix-cache engine with quantized KV pages and ALL-greedy requests:
+    exact parity relaxes to the >= 0.999 served-token top-1 agreement
+    floor (the same floor the autopick gate enforces), so fault-driven
+    retry/skip paths are exercised through the quantized write path too.
 
     The whole leg runs under lockguard: injected faults drive the
     engine's error paths (submit retry, decode skip, eviction on
@@ -182,10 +188,19 @@ def run_serving(seed: int) -> dict:
                             remat=False, xent_chunk=0)
     model = TransformerLM(cfg)
     params = model.init(jax.random.key(11))
+    # quantized KV holds a top-1 agreement floor, not bitwise parity —
+    # meaningful only for greedy decoding, so the int8 leg pins temp to 0
+    # and sharpens the model so margins measure the quantizer, not init
+    # noise (see serving_smoke._sharpen)
+    temps = [0.0, 0.8]
+    if kv_quant is not None:
+        from tools.serving_smoke import _sharpen
+        params = _sharpen(model, params, cfg)
+        temps = [0.0]
     reqs = [dict(prompt=[rng.randrange(cfg.vocab_size)
                          for _ in range(rng.randint(1, 10))],
                  max_new_tokens=rng.randint(1, 8),
-                 temperature=rng.choice([0.0, 0.8]),
+                 temperature=rng.choice(temps),
                  seed=rng.randrange(1 << 16))
             for _ in range(5)]
     expected = [model.sample(params, r["prompt"], r["max_new_tokens"],
@@ -200,12 +215,14 @@ def run_serving(seed: int) -> dict:
                        max_fires=decode_fires),
              FaultSpec("serving.request", at_step=submit_fire_at)]
     submit_faults = 0
+    scfg = (ServingConfig(slots=3, resolve_every=2) if kv_quant is None
+            else ServingConfig(slots=3, resolve_every=2, paged=True,
+                               page_size=4, prefix_cache=True,
+                               kv_quant=kv_quant))
     guard = LockGuard().install()
     try:
         with inject_faults(*specs, seed=seed):
-            engine = InferenceEngine(
-                model, params=params,
-                cfg=ServingConfig(slots=3, resolve_every=2)).start()
+            engine = InferenceEngine(model, params=params, cfg=scfg).start()
             handles = []
             for r in reqs:
                 try:
@@ -221,16 +238,27 @@ def run_serving(seed: int) -> dict:
         guard.uninstall()
 
     parity = all(o.tokens == e for o, e in zip(outs, expected))
+    total = sum(len(e) for e in expected)
+    agree = sum(1 for o, e in zip(outs, expected)
+                for x, y in zip(o.tokens, e) if x == y)
+    agreement = agree / total if total else 0.0
     result = {
         "seed": seed,
         "requests": len(reqs),
+        "kv_quant": kv_quant,
         "token_parity_under_faults": parity,
+        "token_agreement_under_faults": agreement,
         "decode_faults_fired": fired["serving.decode"],
         "submit_faults_fired": fired["serving.request"],
         "submit_retries": submit_faults,
         "lockguard_violations": len(guard.violations()),
     }
-    assert parity, f"seed {seed}: served tokens diverged under injection"
+    if kv_quant is None:
+        assert parity, f"seed {seed}: served tokens diverged under injection"
+    else:
+        assert agreement >= 0.999, (
+            f"seed {seed}: kv_quant={kv_quant} token agreement "
+            f"{agreement:.4f} under the 0.999 floor")
     assert fired["serving.decode"] == decode_fires, result
     assert fired["serving.request"] == 1 and submit_faults == 1, result
     assert not guard.violations(), guard.report()
@@ -254,6 +282,7 @@ def main(argv: list[str]) -> int:
     result["zero_stages"] = {
         stage: run(base + stage, zero_stage=stage) for stage in (1, 2, 3)}
     result["serving"] = run_serving(base)
+    result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
     print(json.dumps(result))
     return 0
 

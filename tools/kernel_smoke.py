@@ -61,6 +61,10 @@ def _cases():
         jax.random.fold_in(k, 11),
         jnp.arange(n_phys, dtype=jnp.int32))[: s["B"] * 4].reshape(s["B"], 4)
     lens = jnp.asarray([ps * 4, ps * 2 + 3], jnp.int32)
+    from deeplearning4j_tpu.ops.pallas import kv_quant as kvq
+    s0 = jnp.full((n_phys, s["H"]), kvq.neutral_scale(jnp.int8), jnp.float32)
+    pkq, pks = kvq.requantize_pool(pk, s0, jnp.int8)
+    pvq, pvs = kvq.requantize_pool(pv, s0, jnp.int8)
 
     calls = {
         ("attention", None): (lambda fn: fn(q, kk, v, causal=True),
@@ -72,6 +76,9 @@ def _cases():
                                 (x, qw.q, qw.scale)),
         ("paged_attention", None): (lambda fn: fn(pq, pk, pv, bt, lens),
                                     (pq, pk, pv, bt, lens, pq)),
+        ("paged_attention_int8", None): (
+            lambda fn: fn(pq, pkq, pvq, pks, pvs, bt, lens),
+            (pq, pkq, pvq, pks, pvs, bt, lens, pq)),
     }
     for kind in registry.kinds():
         call, io = calls[(kind, None)]

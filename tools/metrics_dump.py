@@ -109,6 +109,39 @@ def render_serving(snap: dict) -> str | None:
                  ("metric", "value"))
 
 
+def render_kv_capacity(snap: dict) -> str | None:
+    """The users-per-chip ledger (ISSUE 12): derive how many concurrent
+    slots the KV page pool can hold from the gauges the engine publishes
+    — ``serving.kv_page_bytes`` x ``kv_pages_total`` is the pool's byte
+    budget, ``kv_bytes_per_slot`` is one user's share at the current
+    sequence budget, and their ratio is the capacity the quant mode +
+    head layout bought.  Returns None without a paged engine's gauges."""
+    gauges = snap.get("gauges", {})
+    page_bytes = gauges.get("serving.kv_page_bytes")
+    pages_total = gauges.get("serving.kv_pages_total")
+    per_slot = gauges.get("serving.kv_bytes_per_slot")
+    if page_bytes is None or pages_total is None:
+        return None
+    pool_bytes = page_bytes * pages_total
+    bits = gauges.get("serving.kv_quant_bits")
+    rows = [
+        ("kv_storage_bits", "?" if bits is None else f"{bits:.0f}"),
+        ("pool_pages", f"{pages_total:.0f}"),
+        ("page_bytes", _fmt_bytes(page_bytes)),
+        ("pool_bytes", _fmt_bytes(pool_bytes)),
+    ]
+    if "serving.kv_pages_in_use" in gauges:
+        used = gauges["serving.kv_pages_in_use"]
+        rows.append(("pages_in_use",
+                     f"{used:.0f} ({used / max(pages_total, 1) * 100:.1f}%)"))
+    if per_slot:
+        rows.append(("bytes_per_slot", _fmt_bytes(per_slot)))
+        rows.append(("slots_per_pool (users/chip)",
+                     f"{pool_bytes / per_slot:.1f}"))
+    return _rows("kv capacity (users per page pool)", rows,
+                 ("metric", "value"))
+
+
 def render_router(snap: dict) -> str | None:
     """Multi-replica router tier (PR 11): per-replica breaker state /
     in-flight load / queue depth, plus the aggregate affinity, spillover
@@ -173,8 +206,8 @@ def render_metrics(snap: dict) -> str:
     state_mem = render_state_memory(snap)
     if state_mem is not None:
         parts.append(state_mem)
-    for section in (render_serving(snap), render_router(snap),
-                    render_utilization(snap)):
+    for section in (render_serving(snap), render_kv_capacity(snap),
+                    render_router(snap), render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(

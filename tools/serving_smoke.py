@@ -51,6 +51,10 @@ the scraped prefix hit rate and peak KV pages in use, and the scraped
 peak device-KV bytes per occupied slot next to the dense
 ``max_len``-per-slot baseline; the run FAILS unless the hit rate is
 positive and the paged footprint stays under the dense baseline.
+``--kv-quant int8`` runs the workload twice (float leg, then quantized
+leg) and additionally FAILS unless bytes/slot drops >= 1.9x, the hit
+rate does not regress, and greedy served tokens agree top-1 >= 0.999
+across the legs.
 
 Exits nonzero if any request fails, the registry is missing a serving
 histogram, or lockguard saw a violation.
@@ -236,6 +240,31 @@ def run(requests: int = 32, threads: int = 4, seed: int = 0,
     return result
 
 
+def _sharpen(model, params, cfg, steps: int = 80):
+    """A few SGD steps on a cyclic token stream so greedy decoding has
+    decisive top-2 logit margins.  A randomly-initialized model's logits
+    are near-flat — its argmax is a coin toss that ANY perturbation
+    (including int8 KV quantization, ~0.2% of activation absmax) can
+    flip, which would make token-agreement floors measure init noise
+    instead of the quantizer.  Trained margins (~10x the quantization
+    error) make the >= 0.999 agreement assertion test the quantizer."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import lm_loss_local
+
+    toks = jnp.tile(jnp.arange(cfg.vocab_size, dtype=jnp.int32), 2)
+    toks = jnp.broadcast_to(toks[None, :cfg.max_len], (4, cfg.max_len))
+    tgts = (toks + 1) % cfg.vocab_size
+    vg = jax.jit(jax.value_and_grad(
+        lambda p: lm_loss_local(p, toks, tgts, cfg)))
+    for _ in range(steps):
+        _, g = vg(params)
+        params = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                        params, g)
+    return params
+
+
 def _scrape_gauges(prom_text: str, names: tuple[str, ...]) -> dict:
     """Parse plain ``name value`` gauge samples out of a Prometheus
     exposition page (comments and histogram series skipped)."""
@@ -253,9 +282,16 @@ def _scrape_gauges(prom_text: str, names: tuple[str, ...]) -> dict:
 
 
 def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
-               page_size: int = 6, lockguard: bool = False) -> dict:
+               page_size: int = 6, lockguard: bool = False,
+               kv_quant: str | None = None) -> dict:
     """The ``--prefix-workload`` leg: Zipf-shared system prompts against
-    a paged + prefix-cache engine, observed through real scrapes."""
+    a paged + prefix-cache engine, observed through real scrapes.
+
+    With ``kv_quant`` set (``--kv-quant int8``) the SAME workload runs
+    twice — float leg then quantized leg — and the run FAILS unless the
+    scraped peak ``serving.kv_bytes_per_slot`` drops >= 1.9x, the prefix
+    hit rate does not regress, and temperature-0 served tokens agree
+    top-1 >= 0.999 between the legs."""
     import time as _time
 
     import jax
@@ -270,7 +306,6 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
                                             ServingError)
 
     observability.enable()
-    METRICS.reset()
 
     guard = None
     if lockguard:
@@ -283,6 +318,8 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
                             remat=False, xent_chunk=0)
     model = TransformerLM(cfg)
     params = model.init(jax.random.key(7))
+    if kv_quant is not None:
+        params = _sharpen(model, params, cfg)
     dense_bytes_per_slot = (cfg.max_len * cfg.n_heads * cfg.head_dim * 2
                             * cfg.n_layers * jnp.dtype(cfg.dtype).itemsize)
 
@@ -304,65 +341,86 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
                           temperature=rng.choice([0.0, 0.7]),
                           seed=rng.randrange(1 << 20)))
 
-    failures: list[str] = []
-    statuses: list[int] = []
-    lock = threading.Lock()
-    scraped: dict[str, float] = {}       # name -> peak value seen
     scrape_names = ("serving_prefix_hit_rate", "serving_kv_pages_in_use",
                     "serving_kv_bytes_per_slot", "serving_kv_bytes")
-    done = threading.Event()
 
-    engine = InferenceEngine(
-        model, params=params,
-        cfg=ServingConfig(slots=4, resolve_every=4, paged=True,
-                          page_size=page_size, prefix_cache=True))
-    with engine, ModelServer(engine=engine) as server:
-        client = ServingClient(port=server.port)
+    def leg(kvq: str | None) -> dict:
+        """One full pass of the workload against a fresh engine; scraped
+        peaks + per-plan completions for cross-leg agreement."""
+        METRICS.reset()
+        failures: list[str] = []
+        statuses: list[int] = []
+        tokens_by_plan: dict[int, list[int]] = {}
+        lock = threading.Lock()
+        scraped: dict[str, float] = {}   # name -> peak value seen
+        done = threading.Event()
 
-        def scraper():
-            # a real Prometheus poller: GET /metrics.prom on an interval,
-            # keep the peaks (footprint claims come from scrapes, not
-            # from reaching into the engine)
-            while not done.is_set():
-                try:
-                    vals = _scrape_gauges(client.metrics_prom(),
-                                          scrape_names)
-                    with lock:
-                        for k, v in vals.items():
-                            scraped[k] = max(scraped.get(k, 0.0), v)
-                except ServingError:
-                    pass
-                done.wait(0.05)
+        engine = InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=4, resolve_every=4, paged=True,
+                              page_size=page_size, prefix_cache=True,
+                              kv_quant=kvq))
+        with engine, ModelServer(engine=engine) as server:
+            client = ServingClient(port=server.port)
 
-        def worker(mine):
-            for plan in mine:
-                try:
-                    out = client.generate(**plan)
-                    with lock:
-                        statuses.append(200)
-                    if len(out["tokens"]) > plan["max_new_tokens"]:
+            def scraper():
+                # a real Prometheus poller: GET /metrics.prom on an
+                # interval, keep the peaks (footprint claims come from
+                # scrapes, not from reaching into the engine)
+                while not done.is_set():
+                    try:
+                        vals = _scrape_gauges(client.metrics_prom(),
+                                              scrape_names)
                         with lock:
-                            failures.append(f"overlong answer for {plan}")
-                except ServingError as e:
-                    with lock:
-                        statuses.append(e.status)
-                        failures.append(str(e))
+                            for k, v in vals.items():
+                                scraped[k] = max(scraped.get(k, 0.0), v)
+                    except ServingError:
+                        pass
+                    done.wait(0.05)
 
-        scrape_t = threading.Thread(target=scraper, daemon=True)
-        scrape_t.start()
-        ts = [threading.Thread(target=worker, args=(plans[i::threads],))
-              for i in range(threads)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        _time.sleep(0.1)                 # let eviction-fence gauges land
-        final = _scrape_gauges(client.metrics_prom(), scrape_names)
-        done.set()
-        scrape_t.join()
-        with lock:
-            for k, v in final.items():
-                scraped[k] = max(scraped.get(k, 0.0), v)
+            def worker(mine):
+                for idx, plan in mine:
+                    try:
+                        out = client.generate(**plan)
+                        with lock:
+                            statuses.append(200)
+                            tokens_by_plan[idx] = out["tokens"]
+                        if len(out["tokens"]) > plan["max_new_tokens"]:
+                            with lock:
+                                failures.append(
+                                    f"overlong answer for {plan}")
+                    except ServingError as e:
+                        with lock:
+                            statuses.append(e.status)
+                            failures.append(str(e))
+
+            scrape_t = threading.Thread(target=scraper, daemon=True)
+            scrape_t.start()
+            numbered = list(enumerate(plans))
+            ts = [threading.Thread(target=worker,
+                                   args=(numbered[i::threads],))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            _time.sleep(0.1)             # let eviction-fence gauges land
+            final = _scrape_gauges(client.metrics_prom(), scrape_names)
+            done.set()
+            scrape_t.join()
+            with lock:
+                for k, v in final.items():
+                    scraped[k] = max(scraped.get(k, 0.0), v)
+        return {"failures": failures, "completed": statuses.count(200),
+                "rejected": len(statuses) - statuses.count(200),
+                "scraped": scraped, "tokens": tokens_by_plan}
+
+    float_leg = leg(None)
+    quant_leg = leg(kv_quant) if kv_quant is not None else None
+    primary = quant_leg if quant_leg is not None else float_leg
+    failures = list(float_leg["failures"])
+    if quant_leg is not None:
+        failures += quant_leg["failures"]
 
     if guard is not None:
         guard.uninstall()
@@ -378,20 +436,25 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
         return {"p50": t["p50_s"], "p99": t["p99_s"], "count": t["count"],
                 "mean": t["mean_s"]} if t else None
 
-    hit_rate = scraped.get("serving_prefix_hit_rate", 0.0)
-    peak_bytes_per_slot = scraped.get("serving_kv_bytes_per_slot", 0.0)
+    hit_rate = primary["scraped"].get("serving_prefix_hit_rate", 0.0)
+    peak_bytes_per_slot = primary["scraped"].get(
+        "serving_kv_bytes_per_slot", 0.0)
+    float_bytes_per_slot = float_leg["scraped"].get(
+        "serving_kv_bytes_per_slot", 0.0)
     result = {
         "workload": "prefix",
         "requests": requests,
         "threads": threads,
         "seed": seed,
         "page_size": page_size,
-        "completed": statuses.count(200),
-        "rejected": len(statuses) - statuses.count(200),
+        "kv_quant": kv_quant,
+        "completed": primary["completed"],
+        "rejected": primary["rejected"],
         "request_latency_s": pct("serving.request_latency"),
         "ttft_s": pct("serving.ttft"),
         "prefix_hit_rate": hit_rate,
-        "kv_pages_in_use_peak": scraped.get("serving_kv_pages_in_use"),
+        "kv_pages_in_use_peak": primary["scraped"].get(
+            "serving_kv_pages_in_use"),
         "kv_bytes_per_slot_peak": peak_bytes_per_slot,
         "dense_kv_bytes_per_slot": dense_bytes_per_slot,
         "failures": failures[:5],
@@ -399,11 +462,46 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
     if guard is not None:
         result["lockguard_violations"] = len(guard.violations())
     assert not failures, failures[:5]
-    assert result["completed"] == requests
+    assert float_leg["completed"] == requests
+    assert primary["completed"] == requests
     assert hit_rate > 0.0, "prefix cache never hit under a Zipf workload"
     assert 0.0 < peak_bytes_per_slot < dense_bytes_per_slot, (
         f"paged KV bytes/slot {peak_bytes_per_slot} not below dense "
         f"baseline {dense_bytes_per_slot}")
+
+    if quant_leg is not None:
+        # the ISSUE-12 capacity claim, observed through real scrapes:
+        # quantized bytes/slot must drop >= 1.9x, locality must hold,
+        # and greedy served tokens must agree top-1 across the legs
+        shrink = (float_bytes_per_slot / peak_bytes_per_slot
+                  if peak_bytes_per_slot else 0.0)
+        float_hit = float_leg["scraped"].get("serving_prefix_hit_rate", 0.0)
+        agree, compared = 0, 0
+        for idx, plan in enumerate(plans):
+            if plan["temperature"] != 0.0:
+                continue
+            a = float_leg["tokens"].get(idx)
+            b = quant_leg["tokens"].get(idx)
+            if a is None or b is None:
+                continue
+            compared += len(a)
+            agree += sum(1 for x, y in zip(a, b) if x == y)
+        agreement = agree / compared if compared else 0.0
+        result["kv_bytes_per_slot_float"] = float_bytes_per_slot
+        result["kv_bytes_per_slot_shrink"] = shrink
+        result["prefix_hit_rate_float"] = float_hit
+        result["greedy_token_agreement"] = agreement
+        result["greedy_tokens_compared"] = compared
+        assert shrink >= 1.9, (
+            f"kv_quant={kv_quant} bytes/slot shrink {shrink:.2f}x under "
+            "the 1.9x floor")
+        assert hit_rate >= float_hit - 0.05, (
+            f"prefix hit rate regressed under kv_quant: {hit_rate:.3f} vs "
+            f"float {float_hit:.3f}")
+        assert compared > 0, "no greedy completions to compare across legs"
+        assert agreement >= 0.999, (
+            f"served-token top-1 agreement {agreement:.4f} under the "
+            "0.999 floor")
     return result
 
 
@@ -641,7 +739,8 @@ def main(argv: list[str]) -> int:
                          threads=arg("--threads", 4),
                          seed=arg("--seed", 0),
                          page_size=arg("--page-size", 6),
-                         lockguard="--lockguard" in argv)
+                         lockguard="--lockguard" in argv,
+                         kv_quant=arg("--kv-quant", None, str))
     else:
         out = run(requests=arg("--requests", 32),
                   threads=arg("--threads", 4),

@@ -443,6 +443,86 @@ def pallas_battery(iters=8, shapes=None):
                            "block": dict(blk), "error": repr(e)[:300]}
 
 
+def kv_battery(iters=8, shapes=None):
+    """KV-precision rows for the serving decode read (DESIGN.md §20):
+    every ``paged_attention_int8`` candidate checked against the FLOAT
+    pool's reference — ``max_err`` is the quantization band and
+    ``top1_agree`` the adoption statistic the registry gate floors at
+    0.999 — plus timing rows, a GQA (n_kv_heads < n_heads) geometry
+    for each, and the per-page byte accounting behind the capacity
+    table in ``tools/metrics_dump.py``.  Same JSONL schema as
+    ``pallas_battery``."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas import registry
+    from deeplearning4j_tpu.ops.pallas import kv_quant as kvq
+    from deeplearning4j_tpu.ops.pallas.matmul_int8 import top1_agreement
+    from deeplearning4j_tpu.ops.pallas.paged_attention import \
+        reference_paged_attention
+
+    rng = np.random.default_rng(0)
+    B, H, D, ps, npg = shapes or (8, 16, 128, 16, 32)
+    n_phys = B * npg + 1
+
+    def geometry(kv_heads):
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        kf, vf = (jnp.asarray(rng.standard_normal((n_phys, ps, kv_heads, D)),
+                              jnp.float32) for _ in range(2))
+        bt = jnp.asarray(rng.permutation(n_phys)[: B * npg].reshape(B, npg),
+                         jnp.int32)
+        ln = jnp.asarray(rng.integers(1, npg * ps + 1, B), jnp.int32)
+        s0 = jnp.full((n_phys, kv_heads), kvq.neutral_scale(jnp.int8),
+                      jnp.float32)
+        kq, ks = kvq.requantize_pool(kf, s0, jnp.int8)
+        vq, vs = kvq.requantize_pool(vf, s0, jnp.int8)
+        return q, kf, vf, kq, vq, ks, vs, bt, ln
+
+    for kv_heads in (H, H // 4):                 # MHA and 4-way GQA reads
+        q, kf, vf, kq, vq, ks, vs, bt, ln = geometry(kv_heads)
+        want = reference_paged_attention(q, kf, vf, bt, ln)
+        for cand in registry.candidates("paged_attention_int8"):
+            try:
+                got = cand.fn(q, kq, vq, ks, vs, bt, ln)
+                yield {"kernel": "paged_attention_int8",
+                       "candidate": cand.name, "kv_heads": kv_heads,
+                       "check": {
+                           "max_err": float(np.max(np.abs(
+                               np.asarray(got, np.float32)
+                               - np.asarray(want, np.float32)))),
+                           "top1_agree": float(top1_agreement(got, want))}}
+            except Exception as e:
+                yield {"kernel": "paged_attention_int8",
+                       "candidate": cand.name, "kv_heads": kv_heads,
+                       "check_error": repr(e)[:300]}
+            try:
+                med = _timed(jax.jit(lambda c=cand:
+                                     c.fn(q, kq, vq, ks, vs, bt, ln)),
+                             iters=iters)
+                yield {"kernel": "paged_attention_int8",
+                       "candidate": cand.name, "kv_heads": kv_heads,
+                       "block": {}, "median_ms": round(med * 1e3, 3),
+                       "tokens_per_sec": round(B / med, 1)}
+            except Exception as e:
+                yield {"kernel": "paged_attention_int8",
+                       "candidate": cand.name, "kv_heads": kv_heads,
+                       "block": {}, "error": repr(e)[:300]}
+    # the capacity arithmetic the serving gauges report, per storage mode
+    import dataclasses as _dc
+
+    from deeplearning4j_tpu.models.transformer import TransformerConfig
+    from deeplearning4j_tpu.serving.engine import kv_page_bytes
+    mcfg = TransformerConfig(vocab_size=32768, d_model=H * D, n_heads=H,
+                             n_layers=24, d_ff=4 * H * D, max_len=ps * npg)
+    for kv_heads in (H, H // 4):
+        cfg = _dc.replace(mcfg, n_kv_heads=kv_heads)
+        fp = kv_page_bytes(cfg, ps, None)
+        for mode in (None,) + kvq.KV_QUANT_MODES:
+            yield {"battery": "kv_capacity", "kv_heads": kv_heads,
+                   "kv_quant": mode, "page_bytes": kv_page_bytes(cfg, ps, mode),
+                   "bytes_vs_float": round(kv_page_bytes(cfg, ps, mode) / fp, 4)}
+
+
 def zero_battery(iters=12, d=4096, batch=64):
     """ZeRO rows: one per stage — step time plus the per-device
     params/opt-state bytes from the trainer's gauges.  On real chips this
@@ -497,6 +577,12 @@ def main():
     out = []
     if which == "zero":
         for row in zero_battery():
+            print(json.dumps(row), flush=True)
+        return
+    if which == "kv":
+        # KV-precision battery: quantized paged-read candidates vs the
+        # float reference + page-byte capacity rows
+        for row in kv_battery():
             print(json.dumps(row), flush=True)
         return
     if which == "pallas":
