@@ -1,4 +1,4 @@
-"""The graftlint rule set — sixteen hazard classes from this repo's history.
+"""The graftlint rule set — seventeen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -44,6 +44,10 @@
 |       | or `models/` outside the quant helpers — an unscaled,            |
 |       | unsaturated cast that silently wraps/overflows instead of going  |
 |       | through `kv_quant.cast_to`/`matmul_int8.quantize`                |
+| EL01  | mesh/topology construction outside the `parallel/mesh.py`        |
+|       | helpers in trainer/supervisor code — a raw `Mesh(...)` or a      |
+|       | `jax.devices()[<literal>]` slice hard-codes a device set the     |
+|       | elastic resize path (shrink/grow/reshard) cannot rebuild         |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -1268,3 +1272,86 @@ class RawQuantCastRule(Rule):
                 "`kv_quant.cast_to`/`requantize_pool` or "
                 "`matmul_int8.quantize` so a scale rides beside the "
                 "bytes (or silence with a reason)")
+
+
+@register
+class ElasticMeshConstructionRule(Rule):
+    """EL01 — mesh/topology construction outside the mesh helpers.
+
+    Elastic training (DESIGN.md §21) rebuilds the mesh at runtime: a
+    device loss shrinks it, a re-registration grows it, and a resharding
+    restore re-splits state onto whatever width came out.  That only
+    works when every mesh in ``parallel/``/``resilience/`` flows through
+    the ``parallel/mesh.py`` helpers (``make_mesh``/``local_mesh``/
+    ``elastic_mesh``/``shrink_mesh``/``grow_mesh``), which keep the
+    device list explicit and the axis layout canonical.  A raw
+    ``jax.sharding.Mesh(...)`` call, or a ``jax.devices()`` /
+    ``jax.local_devices()`` subscript with *integer-literal* bounds
+    (``jax.devices()[:8]``), hard-codes a topology the resize path can
+    neither rebuild nor verify — it is exactly the frozen-device-set bug
+    a shrink turns into a crash.  Variable-bounded slices
+    (``jax.devices()[:n]``) are fine: the width is a parameter the
+    caller can re-derive after a resize.  Scoped to ``parallel/`` and
+    ``resilience/`` excluding ``mesh.py`` itself (the one sanctioned
+    construction site); ``NamedSharding`` over an existing mesh is not
+    construction and is not flagged.
+
+    Blind spots: a ``Mesh`` aliased through a variable
+    (``M = Mesh; M(...)``), and device lists materialized in another
+    module and passed in.  Silence a deliberate fixed topology with
+    ``# graftlint: disable=EL01`` plus the reason.
+    """
+
+    id = "EL01"
+    title = "raw mesh construction outside parallel/mesh.py helpers"
+
+    _DEVICE_ENUMS = {"jax.devices", "jax.local_devices"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "parallel/" not in path and "resilience/" not in path:
+            return
+        if path.endswith("parallel/mesh.py"):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                canon = (module.canonical(node.func)
+                         or dotted_name(node.func) or "")
+                if (last_segment(canon) or canon) == "Mesh":
+                    yield self.finding(
+                        module, node,
+                        "raw `Mesh(...)` constructor outside "
+                        "`parallel/mesh.py` — the elastic resize path "
+                        "(shrink/grow/reshard, DESIGN.md §21) can only "
+                        "rebuild meshes made by the helpers; use "
+                        "`make_mesh`/`local_mesh`/`elastic_mesh` (or "
+                        "silence with a reason)")
+            elif isinstance(node, ast.Subscript):
+                v = node.value
+                if not isinstance(v, ast.Call):
+                    continue
+                canon = (module.canonical(v.func)
+                         or dotted_name(v.func) or "")
+                if canon not in self._DEVICE_ENUMS:
+                    continue
+                if self._literal_bounds(node.slice):
+                    yield self.finding(
+                        module, node,
+                        f"`{canon}()` subscripted with integer-literal "
+                        "bounds hard-codes a device set — after a "
+                        "shrink/grow the literal is stale and the slice "
+                        "silently picks the wrong chips; derive the "
+                        "width from the mesh (or a parameter) and build "
+                        "through `elastic_mesh`/`make_mesh`")
+
+    @staticmethod
+    def _literal_bounds(sl: ast.AST) -> bool:
+        """True for ``[3]`` / ``[:8]`` / ``[2:6]``; False when every
+        bound is a name/expression the caller computes (``[:n]``)."""
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+            return True
+        if isinstance(sl, ast.Slice):
+            return any(isinstance(b, ast.Constant)
+                       and isinstance(b.value, int)
+                       for b in (sl.lower, sl.upper))
+        return False

@@ -18,13 +18,15 @@ supersteps + ZooKeeper config, SURVEY.md §2.3) collapses on TPU into:
 """
 
 from .compile_cache import setup_compile_cache
-from .mesh import MeshSpec, local_mesh, make_mesh
+from .mesh import (MeshMismatchError, MeshSpec, elastic_mesh, grow_mesh,
+                   local_mesh, make_mesh, shrink_mesh)
 from .trainer import DataParallelTrainer, LazyLoss, TrainState
 from .checkpoint import CheckpointManager
 from .driver import Driver
 
 __all__ = [
     "MeshSpec", "local_mesh", "make_mesh",
+    "MeshMismatchError", "elastic_mesh", "shrink_mesh", "grow_mesh",
     "DataParallelTrainer", "LazyLoss", "TrainState",
     "CheckpointManager", "Driver", "setup_compile_cache",
 ]

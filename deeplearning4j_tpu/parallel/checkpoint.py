@@ -19,11 +19,13 @@ recorded, so the whole detection path is testable in-process.
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import os
 import shutil
 import tempfile
+import time
 import warnings
 from pathlib import Path
 from typing import Any
@@ -34,6 +36,8 @@ import numpy as np
 
 from ..observability import FLIGHTREC, METRICS, trace
 from ..resilience.faults import FAULTS, corrupt_file
+from .mesh import MeshMismatchError
+from .zero import flat_padded_size, host_flat_to_natural
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -55,7 +59,59 @@ def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
     return out
 
 
-def _restore_like(template, arrays: dict[str, np.ndarray]):
+@dataclasses.dataclass
+class _ReshardCtx:
+    """What the restore knows about the widths on either side of the seam.
+
+    ``saved_dp``/``zero_stage`` come from the checkpoint metadata,
+    ``restore_dp`` from the caller; ``reshard`` authorizes host-side
+    re-splits.  ``transformed`` records whether any leaf actually needed
+    one (drives the reshard metrics/chaos accounting).
+    """
+
+    saved_dp: int | None = None
+    restore_dp: int | None = None
+    zero_stage: int | None = None
+    reshard: bool = False
+    transformed: int = 0
+
+
+def _fit_leaf(key: str, arr: np.ndarray, leaf, ctx: _ReshardCtx | None):
+    """Shape-guard one array leaf against its template — the fix for the
+    silent failure mode where a wrong-width flat leaf flowed through
+    ``jnp.asarray`` and only died (or corrupted state) later inside
+    ``zero.py``.  Mismatches that flat-pad arithmetic explains are
+    re-split exactly when ``reshard`` allows; everything else raises a
+    named error here, never a raw reshape error downstream."""
+    want = getattr(leaf, "shape", None)
+    if want is None or tuple(arr.shape) == tuple(want):
+        return arr
+    if ctx is not None and ctx.saved_dp and arr.ndim == 1 \
+            and arr.shape[0] == flat_padded_size(_size_of(want), ctx.saved_dp):
+        # a flat padded P('dp') leaf from the save-side width.  Same-width
+        # flat->natural is layout normalization and always allowed; a
+        # CROSS-width re-split is a reshard and needs the flag.
+        cross = ctx.restore_dp is not None and ctx.restore_dp != ctx.saved_dp
+        if cross and not ctx.reshard:
+            raise MeshMismatchError(ctx.saved_dp, ctx.restore_dp,
+                                    ctx.zero_stage, detail=f"flat leaf {key}")
+        ctx.transformed += 1
+        return host_flat_to_natural(arr, tuple(want), ctx.saved_dp)
+    saved_dp = ctx.saved_dp if ctx is not None else None
+    restore_dp = ctx.restore_dp if ctx is not None else None
+    stage = ctx.zero_stage if ctx is not None else None
+    raise MeshMismatchError(
+        saved_dp, restore_dp, stage,
+        detail=f"leaf {key} has shape {tuple(arr.shape)}, template wants "
+               f"{tuple(want)} and no flat-pad width explains it")
+
+
+def _size_of(shape) -> int:
+    return int(np.prod(shape, dtype=np.int64)) if shape else 1
+
+
+def _restore_like(template, arrays: dict[str, np.ndarray],
+                  ctx: _ReshardCtx | None = None):
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     used = set()
@@ -70,7 +126,7 @@ def _restore_like(template, arrays: dict[str, np.ndarray]):
             # are the ZeRO restore path: the caller re-flattens and
             # re-shards the natural-layout arrays onto its CURRENT mesh,
             # so no concrete template ever needs to materialize here
-            leaves.append(jnp.asarray(arr))
+            leaves.append(jnp.asarray(_fit_leaf(key, arr, leaf, ctx)))
         elif leaf is None:
             # a registered-leaf None (custom pytrees): NoneType() is not
             # callable with an argument — restore the None itself
@@ -120,7 +176,9 @@ class CheckpointManager:
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, params, tstate=None, key=None,
-             data_cursor: int = 0, extra: dict | None = None) -> Path:
+             data_cursor: int = 0, extra: dict | None = None,
+             dp_width: int | None = None, zero_stage: int | None = None,
+             layout: str | None = None) -> Path:
         if self.read_only:
             raise RuntimeError(
                 "CheckpointManager opened read-only (serving open path): "
@@ -133,12 +191,16 @@ class CheckpointManager:
             # front snapshots a consistent state.  (The trainer additionally
             # resolves its pending-loss ring before calling save.)
             jax.block_until_ready((params, tstate))
-            path = self._save(step, params, tstate, key, data_cursor, extra)
+            path = self._save(step, params, tstate, key, data_cursor, extra,
+                              dp_width=dp_width, zero_stage=zero_stage,
+                              layout=layout)
         METRICS.increment("checkpoint.saves")
         return path
 
     def _save(self, step: int, params, tstate=None, key=None,
-              data_cursor: int = 0, extra: dict | None = None) -> Path:
+              data_cursor: int = 0, extra: dict | None = None,
+              dp_width: int | None = None, zero_stage: int | None = None,
+              layout: str | None = None) -> Path:
         ckpt_dir = self.directory / f"ckpt_{step:010d}"
         tmp = Path(tempfile.mkdtemp(dir=self.directory))
         try:
@@ -154,6 +216,13 @@ class CheckpointManager:
                 "has_tstate": tstate is not None,
                 "has_key": key is not None,
                 "extra": extra or {},
+                # topology stamp: the dp width / zero stage / leaf layout
+                # this checkpoint was written under — what the resharding
+                # restore (and the MeshMismatchError contract) keys off.
+                # ``layout`` is "natural" (gathered, width-agnostic) or
+                # "flat" (padded P('dp') vectors of the save-side width).
+                "topology": {"dp": dp_width, "zero_stage": zero_stage,
+                             "layout": layout or "natural"},
                 # per-file SHA-256 manifest: verify() recomputes these; a
                 # checkpoint whose payloads do not match is never restored
                 "checksums": {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
@@ -224,22 +293,34 @@ class CheckpointManager:
         return None
 
     def restore(self, params_template, tstate_template=None,
-                step: int | None = None) -> dict:
-        """Returns dict(step, params, tstate, key, data_cursor, extra).
+                step: int | None = None, *, reshard: bool = False,
+                dp_width: int | None = None) -> dict:
+        """Returns dict(step, params, tstate, key, data_cursor, extra,
+        saved_dp, zero_stage, resharded).
 
         With ``step=None`` walks back from the newest checkpoint to the
         newest one that verifies, skipping (and counting) corrupt ones;
         an explicit ``step`` that fails verification raises
         :class:`CheckpointCorruptError` instead of loading garbage.
+
+        ``dp_width`` declares the mesh width this restore targets.  When it
+        differs from the width stamped at save time, ``reshard=True``
+        re-splits the state exactly (natural-layout leaves pass through
+        width-agnostic; flat padded ``P('dp')`` leaves are sliced back to
+        natural host-side, no renormalization) and ``reshard=False`` raises
+        :class:`MeshMismatchError` naming both widths — never a raw shape
+        error deep in ``zero.py``.
         """
         with trace.span("checkpoint.restore"), \
                 METRICS.time("checkpoint.restore"):
-            out = self._restore(params_template, tstate_template, step)
+            out = self._restore(params_template, tstate_template, step,
+                                reshard=reshard, dp_width=dp_width)
         METRICS.increment("checkpoint.restores")
         return out
 
     def _restore(self, params_template, tstate_template=None,
-                 step: int | None = None) -> dict:
+                 step: int | None = None, *, reshard: bool = False,
+                 dp_width: int | None = None) -> dict:
         if step is not None:
             if not self.verify(step):
                 METRICS.increment("checkpoint.corrupt_detected")
@@ -265,14 +346,46 @@ class CheckpointManager:
                     "verification (all corrupt)")
         ckpt_dir = self.directory / f"ckpt_{step:010d}"
         meta = json.loads((ckpt_dir / "meta.json").read_text())
+        topo = meta.get("topology") or {}
+        extra = meta.get("extra") or {}
+        saved_dp = topo.get("dp")
+        if saved_dp is None:  # pre-topology checkpoints stamped via extra
+            saved_dp = extra.get("saved_dp")
+        zero_stage = topo.get("zero_stage")
+        if zero_stage is None:
+            zero_stage = extra.get("zero_stage")
+        if (dp_width is not None and saved_dp is not None
+                and int(saved_dp) != int(dp_width) and not reshard):
+            # the silent failure mode, made loud: cross-width restore with
+            # resharding off fails HERE with both widths named, for every
+            # zero stage — even when a size coincidence would have let the
+            # leaves through.
+            raise MeshMismatchError(int(saved_dp), int(dp_width), zero_stage)
+        ctx = _ReshardCtx(
+            saved_dp=int(saved_dp) if saved_dp is not None else None,
+            restore_dp=int(dp_width) if dp_width is not None else None,
+            zero_stage=zero_stage, reshard=reshard)
+        cross_width = (ctx.saved_dp is not None and ctx.restore_dp is not None
+                       and ctx.saved_dp != ctx.restore_dp)
+        if cross_width:
+            # chaos seam: a reshard that dies mid-flight (preempted host,
+            # OOM during the re-split) — transient, retried by the
+            # supervisor like any other step fault
+            FAULTS.maybe_fire("checkpoint.reshard", step)
+        t0 = time.monotonic()
         params_npz = np.load(ckpt_dir / "params.npz")
-        params = _restore_like(params_template, dict(params_npz))
+        params = _restore_like(params_template, dict(params_npz), ctx)
         tstate = None
         if meta["has_tstate"] and tstate_template is not None:
-            tstate = _restore_like(tstate_template, dict(np.load(ckpt_dir / "tstate.npz")))
+            tstate = _restore_like(
+                tstate_template, dict(np.load(ckpt_dir / "tstate.npz")), ctx)
         key = None
         if meta["has_key"]:
             key = jax.random.wrap_key_data(jnp.asarray(np.load(ckpt_dir / "key.npy")))
+        resharded = cross_width or ctx.transformed > 0
+        if cross_width:
+            METRICS.increment("checkpoint.reshards")
+            METRICS.gauge("elastic.reshard_seconds", time.monotonic() - t0)
         return {
             "step": meta["step"],
             "params": params,
@@ -280,4 +393,7 @@ class CheckpointManager:
             "key": key,
             "data_cursor": meta["data_cursor"],
             "extra": meta["extra"],
+            "saved_dp": ctx.saved_dp,
+            "zero_stage": zero_stage,
+            "resharded": resharded,
         }

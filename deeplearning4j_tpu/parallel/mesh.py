@@ -26,6 +26,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
 
 
+class MeshMismatchError(RuntimeError):
+    """A checkpoint written at one dp width met a mesh of another width
+    without ``reshard=True`` (or with shapes that no reshard can explain).
+
+    Carries both widths and the zero_stage so the operator can tell at a
+    glance whether to pass ``reshard=True`` or fix the mesh spec.
+    """
+
+    def __init__(self, saved_dp, restore_dp, zero_stage, detail: str = ""):
+        self.saved_dp = saved_dp
+        self.restore_dp = restore_dp
+        self.zero_stage = zero_stage
+        msg = (f"checkpoint written at dp={saved_dp} cannot restore onto "
+               f"dp={restore_dp} (zero_stage={zero_stage}) without "
+               f"reshard=True")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Named mesh shape; -1 on one axis means 'absorb remaining devices'."""
@@ -71,6 +91,53 @@ def local_mesh(n: int | None = None, axis: str = DP) -> Mesh:
     """1-axis mesh over local devices (the common data-parallel case)."""
     devices = jax.devices()[: (n or len(jax.devices()))]
     return Mesh(np.array(devices), (axis,))
+
+
+def mesh_devices(mesh: Mesh) -> list:
+    """Flat device list of a mesh, in axis order."""
+    return list(mesh.devices.flat)
+
+
+def dp_width(mesh: Mesh) -> int:
+    """Data-parallel width of a mesh (1 when it has no dp axis)."""
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get(DP, 1))
+
+
+def elastic_mesh(devices: Sequence, axis: str = DP) -> Mesh:
+    """1-axis mesh over an explicit device list — the topology-change
+    primitive: every shrink/grow rebuild goes through here so elastic code
+    never hardcodes a device count (graftlint EL01)."""
+    devices = list(devices)
+    if not devices:
+        raise ValueError("elastic_mesh: no surviving devices")
+    return Mesh(np.array(devices), (axis,))
+
+
+def shrink_mesh(mesh: Mesh, lost: Sequence) -> Mesh:
+    """Rebuild a dp mesh from the survivors after losing ``lost`` devices.
+
+    Elasticity is dp-only: multi-axis meshes (tp/pp/...) have rigid
+    collective schedules and must be rebuilt from a MeshSpec instead.
+    """
+    if set(mesh.axis_names) - {DP}:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if any(v != 1 for a, v in sizes.items() if a != DP):
+            raise ValueError(f"shrink_mesh: only dp meshes are elastic, got {sizes}")
+    lost_ids = {id(d) for d in lost} | {getattr(d, "id", None) for d in lost}
+    survivors = [d for d in mesh_devices(mesh)
+                 if id(d) not in lost_ids and getattr(d, "id", None) not in lost_ids]
+    if len(survivors) == len(mesh_devices(mesh)):
+        raise ValueError("shrink_mesh: no listed device is in the mesh")
+    return elastic_mesh(survivors)
+
+
+def grow_mesh(mesh: Mesh, regained: Sequence) -> Mesh:
+    """Rebuild a dp mesh with ``regained`` devices appended (devices already
+    present are ignored, so re-registration is idempotent)."""
+    current = mesh_devices(mesh)
+    have = {getattr(d, "id", id(d)) for d in current}
+    added = [d for d in regained if getattr(d, "id", id(d)) not in have]
+    return elastic_mesh(current + added)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

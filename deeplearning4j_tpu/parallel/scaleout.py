@@ -38,7 +38,7 @@ from typing import Any, Callable, Iterator, Protocol, Sequence
 
 import numpy as np
 
-from ..observability import METRICS, trace
+from ..observability import FLIGHTREC, METRICS, trace
 from ..resilience.faults import FAULTS, WorkerKilled
 
 
@@ -561,7 +561,11 @@ class DistributedRunner:
     def _maybe_respawn(self) -> None:
         """Top the pool back up to ``n_workers`` after deaths/evictions,
         bounded by ``max_respawns`` (a deterministic crash loop must run
-        out of budget, not respawn forever)."""
+        out of budget, not respawn forever).  Once the budget is exhausted
+        the wave SHRINKS to the live worker count instead of running with
+        a hole: ``IterativeReduceWorkRouter`` and ``ArrayAggregator``
+        already key off the live worker set, so superstep averages are
+        weighted by the surviving wave, not a fixed composition."""
         live = len(self.tracker.workers())
         while live < self.n_workers and self._respawned < self.max_respawns:
             wid = f"worker-{self._worker_seq}"
@@ -570,6 +574,37 @@ class DistributedRunner:
             self._spawn_one(wid)
             METRICS.increment("scaleout.workers_respawned")
             live += 1
+        if 0 < live < self.n_workers:
+            # a worker stayed dead past its respawn budget — accept the
+            # smaller wave (elastic shrink) rather than waiting on a
+            # phantom.  (live == 0 is left to the run deadline: there is
+            # no wave to shrink to.)
+            old = self.n_workers
+            self.n_workers = live
+            METRICS.increment("scaleout.wave_shrinks")
+            METRICS.gauge("elastic.wave_size", live)
+            FLIGHTREC.dump("mesh_resize", extra={
+                "kind": "scaleout_wave", "direction": "shrink",
+                "old_wave": old, "new_wave": live,
+                "workers": self.tracker.workers()})
+
+    def register_worker(self, worker_id: str | None = None) -> str:
+        """Grow the wave: admit a new (or re-registering) worker into a
+        live run.  Raises the target ``n_workers`` so the master expects
+        the larger wave, spawns the worker, and notes the resize — the
+        inverse of the shrink in :meth:`_maybe_respawn`."""
+        wid = worker_id or f"worker-{self._worker_seq}"
+        if worker_id is None:
+            self._worker_seq += 1
+        old = self.n_workers
+        self.n_workers += 1
+        self._spawn_one(wid)
+        METRICS.increment("scaleout.wave_grows")
+        METRICS.gauge("elastic.wave_size", len(self.tracker.workers()))
+        FLIGHTREC.dump("mesh_resize", extra={
+            "kind": "scaleout_wave", "direction": "grow",
+            "old_wave": old, "new_wave": self.n_workers, "worker": wid})
+        return wid
 
     def _shutdown_workers(self) -> None:
         self._stop.set()
@@ -605,6 +640,7 @@ class DistributedRunner:
         self.tracker.reset_done()    # a prior run's DONE must not no-op us
         METRICS.increment("scaleout.runs")
         self._spawn_workers()
+        METRICS.gauge("elastic.wave_size", self.n_workers)
         deadline = time.time() + max_wall_s
         last_evict = time.time()
         requeue: list[Job] = []  # orphaned/failed jobs awaiting re-dispatch

@@ -61,13 +61,13 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..analysis.runtime import allow_transfers, hot_loop_guard
 from ..datasets.dataset import DataSet
-from ..resilience.faults import FAULTS, DivergenceError
+from ..resilience.faults import FAULTS, DeviceLossError, DivergenceError
 from ..observability import COSTS, METRICS, NOOP_SPAN, enabled as _obs_enabled
 from ..observability import sample_device_memory, sample_state_bytes, trace
 from ..optimize import transforms as tfm
 from . import collectives as clv
 from .compile_cache import setup_compile_cache
-from .mesh import DP, local_mesh
+from .mesh import DP, local_mesh, mesh_devices
 from .zero import ZeroLayout
 
 LossFn = Callable[..., jnp.ndarray]  # (params, x, y, key) -> scalar
@@ -491,6 +491,16 @@ class DataParallelTrainer:
                   bucket: int) -> tuple[TrainState, LazyLoss]:
         # chaos seam: transient step failure (disarmed cost: one attr test)
         FAULTS.maybe_fire("train.step", state.step + 1)
+        # chaos seam: device loss — ``kind`` is the number of chips that
+        # "die" (default 1, always leaving at least one survivor).  Raises
+        # DeviceLossError so the supervisor can rebuild the mesh from the
+        # survivors instead of retrying onto dead hardware.
+        spec = FAULTS.check("mesh.shrink", state.step + 1)
+        if spec is not None:
+            devs = mesh_devices(self.mesh)
+            k = int(spec.kind) if str(spec.kind or "").isdigit() else 1
+            k = max(1, min(k, len(devs) - 1)) if len(devs) > 1 else 1
+            raise DeviceLossError(state.step + 1, devs[-k:])
         # Observability is gated on one flag check: when disabled, no span
         # object, no perf_counter read, no registry lock on this path.
         obs = _obs_enabled()
@@ -712,12 +722,20 @@ class DataParallelTrainer:
         return state, losses
 
     # ------------------------------------------------------------------ ckpt
-    def checkpoint(self, state: TrainState, manager) -> None:
+    def checkpoint(self, state: TrainState, manager,
+                   layout: str = "natural") -> None:
         """Fence-then-save: resolve the pending-loss ring and block on the
-        state itself so the snapshot cannot race in-flight steps."""
+        state itself so the snapshot cannot race in-flight steps.
+
+        ``layout="natural"`` (default) gathers ZeRO state back to natural
+        shapes — the width-agnostic on-disk format.  ``layout="flat"``
+        writes the on-device flat padded ``P('dp')`` leaves as-is (skipping
+        the unflatten); the manager stamps the save-side width so a restore
+        at any other width re-splits host-side, exactly."""
         self._resolve_pending()
         jax.block_until_ready((state.params, state.tstate))
         METRICS.increment("checkpoint.fences")
+        flat = layout == "flat" and self.zero_stage >= 1
         # the save pulls every leaf to host: a sanctioned sync point, so it
         # re-allows transfers even when called inside the guarded fit loop
         with allow_transfers():
@@ -729,27 +747,35 @@ class DataParallelTrainer:
                 # (np.asarray on a dp-sharded leaf assembles the full
                 # array from its chunks — single-host gather)
                 z = self._zero
-                tstate = z.to_natural_host(tstate, z.natural_tstate)
-                if self.zero_stage >= 3:
-                    params = z.to_natural_host(params, z.natural_params)
+                if not flat:
+                    tstate = z.to_natural_host(tstate, z.natural_tstate)
+                    if self.zero_stage >= 3:
+                        params = z.to_natural_host(params, z.natural_params)
                 extra = {"zero_stage": self.zero_stage,
                          "saved_dp": int(self.n_dp)}
             manager.save(state.step, params, tstate=tstate,
-                         key=state.key, data_cursor=state.step, extra=extra)
+                         key=state.key, data_cursor=state.step, extra=extra,
+                         dp_width=int(self.n_dp), zero_stage=self.zero_stage,
+                         layout="flat" if flat else "natural")
 
-    def restore(self, template: TrainState, manager) -> TrainState:
+    def restore(self, template: TrainState, manager,
+                reshard: bool = True) -> TrainState:
         """Restore the latest checkpoint into a state shaped like
         ``template`` (fresh ``init_state`` output), re-placed on the mesh.
 
-        Under zero_stage >= 1 the checkpoint holds the NATURAL layout
-        (see :meth:`checkpoint`), so restoring re-flattens and re-shards
-        onto THIS trainer's mesh — a checkpoint written at dp=2 restores
-        onto dp=1 (and vice versa) bit-for-bit."""
+        Under zero_stage >= 1 the checkpoint holds either the NATURAL
+        layout (see :meth:`checkpoint`) or the flat save-side layout the
+        manager re-splits; restoring re-flattens and re-shards onto THIS
+        trainer's mesh — a checkpoint written at dp=2 restores onto dp=1
+        (and vice versa) bit-for-bit.  The trainer's contract IS
+        resharding, so ``reshard`` defaults to True; pass False to get the
+        strict ``MeshMismatchError`` behavior across widths."""
         if self.zero_stage >= 1:
-            state = self._restore_zero(template, manager)
+            state = self._restore_zero(template, manager, reshard=reshard)
         else:
             r = manager.restore(template.params,
-                                tstate_template=template.tstate)
+                                tstate_template=template.tstate,
+                                reshard=reshard, dp_width=int(self.n_dp))
             params = jax.tree_util.tree_map(
                 lambda t, a: jax.device_put(jnp.asarray(a), t.sharding),
                 template.params, r["params"])
@@ -765,7 +791,8 @@ class DataParallelTrainer:
         sample_state_bytes(state.params, state.tstate)  # ZeRO memory gauges
         return state
 
-    def _restore_zero(self, template: TrainState, manager) -> TrainState:
+    def _restore_zero(self, template: TrainState, manager,
+                      reshard: bool = True) -> TrainState:
         """Reshard a natural-layout checkpoint onto the current mesh: load
         against abstract natural templates, then jit-flatten each tree
         straight into its cached dp sharding (no replicated intermediate)."""
@@ -782,7 +809,8 @@ class DataParallelTrainer:
         # and re-placing them is setup, not the hot loop
         with allow_transfers():
             r = manager.restore(z.natural_params,
-                                tstate_template=z.natural_tstate)
+                                tstate_template=z.natural_tstate,
+                                reshard=reshard, dp_width=int(self.n_dp))
             nat_params = jax.tree_util.tree_map(jnp.asarray, r["params"])
             if self.zero_stage >= 3:
                 params = z.place_flat(nat_params, z.flat_sharding)

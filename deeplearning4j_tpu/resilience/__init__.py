@@ -8,7 +8,9 @@ Three cooperating parts:
   preemption), armed via :func:`inject_faults` or ``DL4J_TPU_FAULTS``.
 - :mod:`.supervisor` — :class:`TrainingSupervisor`: bounded retry with
   backoff + jitter, resume from the newest *valid* checkpoint, NaN/Inf
-  divergence rollback, SIGTERM/SIGINT emergency checkpointing.
+  divergence rollback, SIGTERM/SIGINT emergency checkpointing, and —
+  given a trainer factory — elastic topology resize on device loss or
+  re-admission (DESIGN.md §21).
 - hardening in the layers underneath (``parallel/checkpoint.py`` checksum
   verification and restore fallback; ``parallel/scaleout.py`` job retry
   budgets, poison-job quarantine, execution timeouts) — see those modules.
@@ -17,6 +19,7 @@ Three cooperating parts:
 from .faults import (
     FAULTS,
     DataIteratorFault,
+    DeviceLossError,
     DivergenceError,
     FaultInjector,
     FaultSpec,
@@ -32,7 +35,8 @@ from .faults import (
 from .supervisor import RetryPolicy, SupervisorReport, TrainingSupervisor
 
 __all__ = [
-    "FAULTS", "DataIteratorFault", "DivergenceError", "FaultInjector",
+    "FAULTS", "DataIteratorFault", "DeviceLossError", "DivergenceError",
+    "FaultInjector",
     "FaultSpec", "InjectedFault", "PreemptionSignal", "RetryPolicy",
     "SupervisorReport", "TrainingPreempted", "TrainingSupervisor",
     "TransientStepFault", "WorkerKilled", "corrupt_file", "inject_faults",

@@ -56,6 +56,20 @@ site                   effect when armed
                        replica (default ``bitflip`` is treated as "any") —
                        the chaos plan for breaker quarantine + ring
                        re-admission
+``mesh.shrink``        :class:`DeviceLossError` raised before dispatching a
+                       train step (``DataParallelTrainer._dispatch``, via
+                       ``FAULTS.check``) — ``kind`` is the number of chips
+                       lost (default 1).  The supervisor rebuilds the mesh
+                       from the survivors and reshards
+``mesh.grow``          the supervisor's ``should_stop`` poll drains the run
+                       (emergency checkpoint), then previously-lost devices
+                       re-register and the mesh is rebuilt LARGER before
+                       resuming (``TrainingSupervisor``, via
+                       ``FAULTS.check``)
+``checkpoint.reshard``  :class:`TransientStepFault` raised inside a
+                       cross-width ``CheckpointManager.restore`` before any
+                       leaf is re-split — a reshard that dies mid-flight is
+                       retried by the supervisor like any step fault
 =====================  =====================================================
 
 Arming:
@@ -127,6 +141,24 @@ class DivergenceError(RuntimeError):
         self.value = value
 
 
+class DeviceLossError(RuntimeError):
+    """One or more accelerator chips dropped out of the mesh mid-run.
+
+    Injected by the ``mesh.shrink`` chaos site (on real hardware the
+    analogue is an XLA runtime error naming a dead core).  Carries the
+    step and the lost device objects so the supervisor can rebuild a mesh
+    from the survivors and reshard onto it.
+    """
+
+    def __init__(self, step: int, devices):
+        self.step = step
+        self.devices = list(devices)
+        names = [str(getattr(d, "id", d)) for d in self.devices]
+        super().__init__(
+            f"lost {len(self.devices)} device(s) [{', '.join(names)}] "
+            f"at step {step}")
+
+
 class TrainingPreempted(RuntimeError):
     """A real SIGTERM/SIGINT arrived: the emergency checkpoint was written
     and the supervisor is handing control back so the process can exit."""
@@ -146,6 +178,7 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "serving.request": TransientStepFault,
     "serving.decode": TransientStepFault,
     "router.route": TransientStepFault,
+    "checkpoint.reshard": TransientStepFault,
 }
 
 

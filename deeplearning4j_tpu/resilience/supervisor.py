@@ -42,7 +42,12 @@ import time
 from typing import Any, Callable
 
 from ..observability import FLIGHTREC, METRICS, trace
-from .faults import FAULTS, DivergenceError, TrainingPreempted
+from .faults import FAULTS, DeviceLossError, DivergenceError, TrainingPreempted
+
+
+def _device_ids(devices) -> list:
+    """JSON-safe device labels for resize bundles."""
+    return [getattr(d, "id", str(d)) for d in devices]
 
 
 def _loss_tail(by_step: dict, n: int = 32) -> dict:
@@ -84,6 +89,8 @@ class SupervisorReport:
     emergency_checkpoints: int = 0
     skipped_steps: int = 0         # batch-window steps skipped after rollback
     resumed_from: list = dataclasses.field(default_factory=list)
+    resizes: int = 0               # topology changes (shrink + grow)
+    mesh_sizes: list = dataclasses.field(default_factory=list)  # after each resize
     # step -> loss for every step a successful attempt resolved; steps
     # whose attempt aborted mid-window are absent (their losses died with
     # the pending ring), so consumers must align by step, not position
@@ -114,6 +121,9 @@ class TrainingSupervisor:
         self._rng = random.Random(seed)
         self._preempt_requested = False
         self._injected_preempt = False
+        self._grow_requested = False
+        self._lost_devices: list = []  # quarantined chips awaiting re-admission
+        self.trainer = None  # the live trainer (rebuilt on every resize)
         self._old_handlers: dict[int, Any] = {}
 
     # ------------------------------------------------------------- signals
@@ -134,14 +144,45 @@ class TrainingSupervisor:
         self._old_handlers.clear()
 
     def _should_stop(self, step: int) -> bool:
-        """The fit loop's per-step preemption poll: real signals and the
-        injected ``preempt`` fault site both land here."""
+        """The fit loop's per-step preemption poll: real signals, the
+        injected ``preempt`` fault site, and the ``mesh.grow`` re-admission
+        signal all land here — each drains the run through the trainer's
+        emergency-checkpoint path before the supervisor acts."""
         if self._preempt_requested:
             return True
         if FAULTS.check("preempt", step) is not None:
             self._injected_preempt = True
             return True
+        if FAULTS.check("mesh.grow", step) is not None:
+            # graceful half of elasticity: a quarantined worker re-registered.
+            # Drain (the trainer writes the emergency checkpoint), then the
+            # fit loop rebuilds the mesh LARGER and resumes from it.
+            self._grow_requested = True
+            return True
         return False
+
+    # ------------------------------------------------------------- elastic
+    def _resize(self, factory, old_devices, new_devices, step, direction):
+        """Rebuild the trainer over ``new_devices`` (detect -> drain ->
+        reshard -> resume, DESIGN.md §21).  The rebuild is timed here; the
+        exact state re-split lands in ``elastic.reshard_seconds`` when the
+        next attempt's restore crosses widths."""
+        t0 = time.monotonic()
+        trainer = factory(list(new_devices))
+        dt = time.monotonic() - t0
+        self.report.resizes += 1
+        self.report.mesh_sizes.append(len(new_devices))
+        METRICS.increment("elastic.mesh_resizes")
+        METRICS.gauge("elastic.mesh_size", len(new_devices))
+        METRICS.gauge("elastic.resizes_total", self.report.resizes)
+        FLIGHTREC.dump("mesh_resize", extra={
+            "direction": direction,
+            "step": int(step) if step is not None else None,
+            "old_devices": _device_ids(old_devices),
+            "new_devices": _device_ids(new_devices),
+            "rebuild_seconds": dt,
+        })
+        return trainer
 
     # ------------------------------------------------------------- generic
     def supervise(self, fn: Callable, *args, **kwargs):
@@ -172,15 +213,33 @@ class TrainingSupervisor:
         replayed after a mid-stream failure).  Returns the final state
         and the per-step losses keyed by step (each step's loss appears
         once even when a window was re-run after a rollback).
+
+        ``trainer`` may also be a FACTORY ``callable(devices) -> trainer``
+        (anything without a ``.fit`` attribute): the supervisor then owns
+        elasticity.  ``factory(None)`` builds the initial trainer over its
+        default devices; on :class:`DeviceLossError` the supervisor drops
+        the dead chips, calls ``factory(survivors)``, and resumes from the
+        newest valid checkpoint with a resharding restore; on a
+        ``mesh.grow`` signal it drains, re-admits the quarantined chips,
+        and rebuilds larger.  Without a factory a device loss propagates —
+        retrying onto a mesh that still names dead hardware helps nobody.
         """
         if self.manager is None:
             raise ValueError("TrainingSupervisor.fit requires a checkpoint_manager")
+        factory = None
+        if callable(trainer) and not hasattr(trainer, "fit"):
+            factory = trainer
+            trainer = factory(None)
+        self.trainer = trainer
+        METRICS.gauge("elastic.mesh_size",
+                      int(trainer.mesh.devices.size))
         data_factory = data if callable(data) else (lambda: data)
         by_step: dict[int, float] = {}
         streak = 0
         rollbacks = 0
         extra_skip = 0
         self._preempt_requested = False
+        self._grow_requested = False
         self._install_signals()
         try:
             with trace.span("resilience.supervised_fit", epochs=epochs):
@@ -224,6 +283,25 @@ class TrainingSupervisor:
                             extra_skip += window
                             self.report.skipped_steps += window
                         continue
+                    except DeviceLossError as e:
+                        # abrupt half of elasticity: chips died mid-step.
+                        # The in-flight window is gone with them — drop it,
+                        # rebuild from the survivors, reshard-resume.
+                        trainer.abort()
+                        METRICS.increment("resilience.device_losses")
+                        if factory is None:
+                            raise
+                        old = list(trainer.mesh.devices.flat)
+                        dead = set(id(d) for d in e.devices)
+                        survivors = [d for d in old if id(d) not in dead]
+                        if not survivors:
+                            METRICS.increment("resilience.gave_up")
+                            raise
+                        self._lost_devices.extend(e.devices)
+                        trainer = self._resize(factory, old, survivors,
+                                               e.step, "shrink")
+                        self.trainer = trainer
+                        continue
                     except self.policy.retry_on as e:
                         trainer.abort()
                         streak += 1
@@ -242,6 +320,21 @@ class TrainingSupervisor:
                     for i, loss in enumerate(losses):
                         by_step[state.step - len(losses) + 1 + i] = loss
                     self.report.losses_by_step = dict(by_step)
+                    if self._grow_requested:
+                        self._grow_requested = False
+                        self.report.emergency_checkpoints += 1
+                        if factory is not None and self._lost_devices:
+                            old = list(trainer.mesh.devices.flat)
+                            have = {id(d) for d in old}
+                            regained = [d for d in self._lost_devices
+                                        if id(d) not in have]
+                            self._lost_devices = []
+                            if regained:
+                                trainer = self._resize(
+                                    factory, old, old + regained,
+                                    state.step, "grow")
+                                self.trainer = trainer
+                        continue  # resume from the drain checkpoint
                     if self._injected_preempt:
                         self.report.preemptions += 1
                         self.report.emergency_checkpoints += 1

@@ -33,6 +33,11 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` sweep — chaos "
+        "replays and other multi-fit end-to-end runs that earn their "
+        "keep in the composed smoke tools, not on every commit")
+    config.addinivalue_line(
+        "markers",
         "no_implicit_transfers: run the test under "
         "jax.transfer_guard('disallow') — any implicit host<->device "
         "transfer inside the test body fails it (hot-loop contract; see "

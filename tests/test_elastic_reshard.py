@@ -1,0 +1,455 @@
+"""Elastic tier (ISSUE 13): resharding restore + live topology resize.
+
+The contract under test, per DESIGN.md §21:
+
+- a checkpoint written at dp-width N restores onto width M for EVERY
+  zero stage — natural-layout leaves pass through width-agnostic, flat
+  padded ``P('dp')`` leaves are re-split host-side EXACTLY (bitwise:
+  slice + reshape, no renormalization);
+- a cross-width restore without ``reshard=True`` raises
+  :class:`MeshMismatchError` naming both widths — never a raw shape
+  error deep in ``zero.py`` (the silent-failure regression, satellite 1);
+- the supervisor survives losing chips mid-run (``mesh.shrink`` ->
+  ``DeviceLossError`` -> rebuild from survivors -> reshard-resume) and
+  gaining them back (``mesh.grow`` -> drain -> rebuild larger), emitting
+  ``mesh_resize`` flight bundles and ``elastic.*`` gauges;
+- the scaleout wave shrinks when a worker stays dead past its respawn
+  budget and grows on :meth:`DistributedRunner.register_worker`.
+
+Loss parity across widths is a WINDOW (|Δ| <= 1e-5), not bitwise: psum
+association order changes with dp width.  Same-width comparisons stay
+bitwise.
+"""
+
+import pathlib
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observability import FLIGHTREC, METRICS
+from deeplearning4j_tpu.optimize import transforms as T
+from deeplearning4j_tpu.parallel import (CheckpointManager,
+                                         DataParallelTrainer, elastic_mesh)
+from deeplearning4j_tpu.parallel.mesh import (MeshMismatchError, grow_mesh,
+                                              shrink_mesh)
+from deeplearning4j_tpu.parallel.scaleout import (CollectionJobIterator,
+                                                  DistributedRunner,
+                                                  StateTracker)
+from deeplearning4j_tpu.parallel.zero import (flat_padded_size,
+                                              host_flat_to_natural,
+                                              host_natural_to_flat)
+from deeplearning4j_tpu.resilience import (FAULTS, DeviceLossError, FaultSpec,
+                                           RetryPolicy, TrainingSupervisor,
+                                           inject_faults)
+
+D = 16
+BATCH = 32          # divisible by every dp width in the matrix
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def _data(n_batches=6, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(D, 1)).astype(np.float32)
+    xs = rng.normal(size=(n_batches * BATCH, D)).astype(np.float32)
+    ys = (xs @ w_true).astype(np.float32)
+    return [(xs[i * BATCH:(i + 1) * BATCH], ys[i * BATCH:(i + 1) * BATCH])
+            for i in range(n_batches)]
+
+
+def _loss_fn(p, xb, yb, key=None):
+    return ((xb @ p["w"] - yb) ** 2).mean()
+
+
+def _trainer(width, stage):
+    return DataParallelTrainer(_loss_fn, T.adam(1e-3),
+                               mesh=elastic_mesh(jax.devices()[:width]),
+                               zero_stage=stage)
+
+
+def _params():
+    return {"w": np.zeros((D, 1), np.float32)}
+
+
+def _host_params(mgr, step=None):
+    """The checkpoint's params gathered to host-natural numpy (the
+    width-agnostic on-disk view both sides of a matrix cell share)."""
+    out = mgr.restore(_params(), step=step)
+    return np.asarray(out["params"]["w"])
+
+
+# --------------------------------------------------------------- mesh helpers
+
+def test_elastic_mesh_shrink_grow_roundtrip():
+    devs = jax.devices()[:8]
+    mesh = elastic_mesh(devs)
+    assert mesh.devices.size == 8
+    smaller = shrink_mesh(mesh, devs[-2:])
+    assert smaller.devices.size == 6
+    assert all(d.id not in {x.id for x in devs[-2:]}
+               for d in smaller.devices.flat)
+    back = grow_mesh(smaller, devs[-2:])
+    assert back.devices.size == 8
+    # idempotent: re-admitting a device already present is a no-op
+    assert grow_mesh(back, devs[-2:]).devices.size == 8
+    with pytest.raises(ValueError):
+        elastic_mesh([])
+
+
+def test_flat_pad_roundtrip_is_exact():
+    rng = np.random.default_rng(1)
+    for shape in ((5,), (3, 7), (2, 3, 4), (1,)):
+        nat = rng.normal(size=shape).astype(np.float32)
+        for dp in (1, 2, 3, 4, 8):
+            flat = host_natural_to_flat(nat, dp)
+            assert flat.shape == (flat_padded_size(nat.size, dp),)
+            np.testing.assert_array_equal(
+                host_flat_to_natural(flat, shape, dp), nat)
+    with pytest.raises(ValueError):
+        host_flat_to_natural(np.zeros(5, np.float32), (3,), 2)
+
+
+# ------------------------------------------------- MeshMismatchError contract
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_cross_width_restore_without_flag_raises_per_stage(tmp_path, stage):
+    """Satellite 1 regression: reshard=False across widths must raise
+    MeshMismatchError naming both widths — for every stage, including the
+    flat layout whose padded leaves would otherwise shape-error (or, on a
+    padding coincidence, silently corrupt)."""
+    src = _trainer(4, stage)
+    state = src.init_state(_params())
+    for x, y in _data(2):
+        state, _ = src.step(state, x, y)
+    mgr = CheckpointManager(tmp_path, keep=5)
+    src.checkpoint(state, mgr)
+    with pytest.raises(MeshMismatchError) as ei:
+        mgr.restore(_params(), step=None, reshard=False, dp_width=2)
+    msg = str(ei.value)
+    assert "dp=4" in msg and "dp=2" in msg and "reshard=True" in msg
+    # trainer-level: a width-2 trainer refuses too when told not to reshard
+    dst = _trainer(2, stage)
+    with pytest.raises(MeshMismatchError):
+        dst.restore(dst.init_state(_params()), mgr, reshard=False)
+
+
+def test_flat_layout_mismatch_is_typed_not_shape_error(tmp_path):
+    """The historical silent failure: a flat padded leaf saved at dp=4
+    fed to a dp=2 template used to reach jnp.asarray unchecked.  It must
+    now surface as MeshMismatchError, whatever the leaf sizes."""
+    src = _trainer(4, 2)
+    state = src.init_state(_params())
+    x, y = _data(1)[0]
+    state, _ = src.step(state, x, y)
+    mgr = CheckpointManager(tmp_path, keep=5)
+    src.checkpoint(state, mgr, layout="flat")
+    dst = _trainer(2, 2)
+    with pytest.raises(MeshMismatchError):
+        dst.restore(dst.init_state(_params()), mgr, reshard=False)
+
+
+def test_same_width_flat_restore_is_layout_normalization(tmp_path):
+    """flat -> natural at the SAME width is not a reshard: it must be
+    allowed with reshard=False (the flag gates topology changes only)."""
+    src = _trainer(4, 2)
+    state = src.init_state(_params())
+    x, y = _data(1)[0]
+    state, _ = src.step(state, x, y)
+    nat_dir, flat_dir = tmp_path / "nat", tmp_path / "flat"
+    src.checkpoint(state, CheckpointManager(nat_dir, keep=5))
+    src.checkpoint(state, CheckpointManager(flat_dir, keep=5),
+                   layout="flat")
+    dst = _trainer(4, 2)
+    restored = dst.restore(dst.init_state(_params()),
+                           CheckpointManager(flat_dir), reshard=False)
+    out_dir = tmp_path / "out"
+    dst.checkpoint(restored, CheckpointManager(out_dir, keep=5))
+    np.testing.assert_array_equal(_host_params(CheckpointManager(out_dir)),
+                                  _host_params(CheckpointManager(nat_dir)))
+
+
+def test_pre_topology_checkpoint_still_restores(tmp_path):
+    """Back-compat: a checkpoint saved before the topology stamp existed
+    (no dp_width/zero_stage meta) restores without the flag — there is no
+    stamped width to mismatch against."""
+    mgr = CheckpointManager(tmp_path, keep=5)
+    mgr.save(1, {"w": np.full((D, 1), 2.0, np.float32)})
+    out = mgr.restore(_params(), dp_width=2)
+    assert out["saved_dp"] is None and not out["resharded"]
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.full((D, 1), 2.0, np.float32))
+
+
+# --------------------------------------------------------------- interop matrix
+
+def test_interop_matrix_restore_is_bitwise_and_step_parity(tmp_path):
+    """Satellite 3: every (save_dp, save_stage) cell restores at a
+    DIFFERENT width and stage with bitwise-identical gathered params, and
+    the first post-restore step matches the fixed-seed uninterrupted run
+    — bitwise at the same width, inside the 1e-5 window across widths."""
+    data = _data(3)
+    next_x, next_y = _data(1, seed=9)[0]
+    other_width = {1: 2, 2: 4, 4: 1}
+    max_cross = 0.0
+    for save_stage in (0, 1, 2, 3):
+        for save_dp in (1, 2, 4):
+            restore_dp = other_width[save_dp]
+            restore_stage = (save_stage + 2) % 4
+            mgr = CheckpointManager(tmp_path / f"{save_stage}-{save_dp}",
+                                    keep=5)
+            src = _trainer(save_dp, save_stage)
+            state = src.init_state(_params())
+            for x, y in data:
+                state, _ = src.step(state, x, y)
+            src.checkpoint(state, mgr)
+            _, ref_lazy = src.step(state, next_x, next_y)  # uninterrupted
+            ref_loss = float(ref_lazy)
+
+            dst = _trainer(restore_dp, restore_stage)
+            restored = dst.restore(dst.init_state(_params()), mgr,
+                                   reshard=True)
+            assert int(restored.step) == int(state.step)
+            out_mgr = CheckpointManager(
+                tmp_path / f"{save_stage}-{save_dp}-out", keep=5)
+            dst.checkpoint(restored, out_mgr)
+            np.testing.assert_array_equal(
+                _host_params(out_mgr), _host_params(mgr),
+                err_msg=(f"save (dp={save_dp}, stage={save_stage}) -> "
+                         f"restore (dp={restore_dp}, stage={restore_stage})"))
+            _, lazy = dst.step(restored, next_x, next_y)
+            max_cross = max(max_cross, abs(float(lazy) - ref_loss))
+    assert max_cross <= 1e-5, f"cross-width window {max_cross:.2e}"
+
+
+def test_flat_layout_cross_width_restore_is_exact(tmp_path):
+    """Flat padded P('dp') leaves saved as-is at dp=4 re-split onto dp=2
+    bitwise — the host-side slice+reshape path, per sharded stage."""
+    x, y = _data(1)[0]
+    for stage in (1, 2, 3):
+        src = _trainer(4, stage)
+        state = src.init_state(_params())
+        state, _ = src.step(state, x, y)
+        nat_dir = tmp_path / f"nat{stage}"
+        flat_dir = tmp_path / f"flat{stage}"
+        src.checkpoint(state, CheckpointManager(nat_dir, keep=5))
+        src.checkpoint(state, CheckpointManager(flat_dir, keep=5),
+                       layout="flat")
+        dst = _trainer(2, stage)
+        restored = dst.restore(dst.init_state(_params()),
+                               CheckpointManager(flat_dir), reshard=True)
+        out_dir = tmp_path / f"out{stage}"
+        dst.checkpoint(restored, CheckpointManager(out_dir, keep=5))
+        np.testing.assert_array_equal(
+            _host_params(CheckpointManager(out_dir)),
+            _host_params(CheckpointManager(nat_dir)),
+            err_msg=f"stage {stage}")
+
+
+def test_reshard_restore_is_counted_and_gauged(tmp_path):
+    src = _trainer(4, 1)
+    state = src.init_state(_params())
+    x, y = _data(1)[0]
+    state, _ = src.step(state, x, y)
+    mgr = CheckpointManager(tmp_path, keep=5)
+    src.checkpoint(state, mgr)
+    METRICS.reset()
+    dst = _trainer(2, 1)
+    dst.restore(dst.init_state(_params()), mgr, reshard=True)
+    snap = METRICS.snapshot()
+    assert snap["counters"].get("checkpoint.reshards", 0) == 1
+    assert snap["gauges"].get("elastic.reshard_seconds", 0.0) > 0.0
+
+
+# --------------------------------------------------------- live supervisor
+
+class _Batch:
+    def __init__(self, x, y):
+        self.features, self.labels = x, y
+
+
+def _sup_fixture(tmp_path):
+    data = [_Batch(x, y) for x, y in _data(8)]
+    stage = 1
+
+    def factory(devices):
+        devs = devices if devices is not None else jax.devices()[:8]
+        return DataParallelTrainer(_loss_fn, T.adam(1e-3),
+                                   mesh=elastic_mesh(devs),
+                                   zero_stage=stage)
+
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=10)
+    sup = TrainingSupervisor(mgr, RetryPolicy(max_attempts=6,
+                                              backoff_base_s=0.01),
+                             install_signal_handlers=False)
+    return factory, mgr, sup, data
+
+
+def test_supervisor_survives_device_loss(tmp_path):
+    """Tentpole: mesh.shrink kills 2 chips mid-run; the supervisor
+    rebuilds from the 6 survivors, reshard-restores, and completes every
+    step inside the documented window — with the mesh_resize bundle and
+    elastic gauges on record."""
+    factory, _, sup, data = _sup_fixture(tmp_path)
+    ref_trainer = factory(None)
+    _, ref_losses = ref_trainer.fit(ref_trainer.init_state(_params()), data,
+                                    epochs=1)
+    old_dump = FLIGHTREC.dump_dir
+    FLIGHTREC.dump_dir = pathlib.Path(tmp_path / "rec")
+    try:
+        METRICS.reset()
+        with inject_faults(FaultSpec("mesh.shrink", at_step=4, kind="2"),
+                           seed=0):
+            state, _ = sup.fit(factory, _params(), data, epochs=1,
+                               checkpoint_every=2)
+        bundles = list(FLIGHTREC.dump_dir.glob("flightrec-mesh_resize-*"))
+    finally:
+        FLIGHTREC.dump_dir = old_dump
+    assert int(state.step) == len(data)
+    assert sup.report.resizes == 1 and sup.report.mesh_sizes == [6]
+    assert int(sup.trainer.mesh.devices.size) == 6
+    assert bundles, "device loss emitted no mesh_resize flight bundle"
+    window = max(abs(v - ref_losses[s - 1])
+                 for s, v in sup.report.losses_by_step.items())
+    assert window <= 1e-5, f"elastic window {window:.2e}"
+    snap = METRICS.snapshot()
+    assert snap["counters"]["resilience.device_losses"] == 1
+    assert snap["counters"]["elastic.mesh_resizes"] == 1
+    assert snap["gauges"]["elastic.mesh_size"] == 6
+    assert snap["gauges"]["elastic.resizes_total"] == 1
+
+
+def test_supervisor_shrinks_then_grows_back(tmp_path):
+    factory, _, sup, data = _sup_fixture(tmp_path)
+    with inject_faults(FaultSpec("mesh.shrink", at_step=3, kind="2"),
+                       FaultSpec("mesh.grow", at_step=5), seed=0):
+        state, _ = sup.fit(factory, _params(), data, epochs=1,
+                           checkpoint_every=2)
+    assert int(state.step) == len(data)
+    assert sup.report.mesh_sizes == [6, 8]
+    assert int(sup.trainer.mesh.devices.size) == 8
+
+
+def test_device_loss_without_factory_propagates(tmp_path):
+    """A plain trainer (no factory) cannot rebuild its mesh — the loss
+    must propagate instead of retrying onto dead hardware."""
+    factory, _, sup, data = _sup_fixture(tmp_path)
+    trainer = factory(None)
+    with inject_faults(FaultSpec("mesh.shrink", at_step=2), seed=0):
+        with pytest.raises(DeviceLossError):
+            sup.fit(trainer, _params(), data, epochs=1, checkpoint_every=2)
+
+
+# --------------------------------------------------------- scaleout wave
+
+class _SumPerformer:
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def perform(self, job):
+        current = self.tracker.get_current()
+        base = np.zeros(4) if current is None else np.asarray(current)
+        job.result = base + np.full(4, float(job.work))
+
+    def update(self, *args):
+        pass
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_wave_shrinks_when_respawn_budget_exhausted():
+    """A worker dies with max_respawns=0: the wave shrinks to the live
+    count (counter + gauge + bundle) and the run still completes."""
+
+    class DieOnce(_SumPerformer):
+        died = []
+        _lock = threading.Lock()
+
+        def perform(self, job):
+            with DieOnce._lock:
+                if not DieOnce.died:
+                    DieOnce.died.append(job.worker_id)
+                    raise SystemExit  # thread exits, heartbeats stop
+            super().perform(job)
+
+    DieOnce.died = []
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(4))
+    runner = DistributedRunner(
+        CollectionJobIterator([1.0, 2.0, 3.0, 4.0]), DieOnce,
+        n_workers=2, tracker=tracker, eviction_timeout_s=0.3,
+        max_respawns=0)
+    METRICS.reset()
+    result = runner.run(max_wall_s=60.0)
+    assert result is not None and tracker.is_done()
+    assert runner.n_workers == 1
+    snap = METRICS.snapshot()
+    assert snap["counters"]["scaleout.wave_shrinks"] >= 1
+    assert snap["gauges"]["elastic.wave_size"] == 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_register_worker_grows_live_wave():
+    gate = threading.Event()
+
+    class Gated(_SumPerformer):
+        def perform(self, job):
+            gate.wait(timeout=30.0)
+            super().perform(job)
+
+    tracker = StateTracker()
+    tracker.set_current(np.zeros(4))
+    runner = DistributedRunner(
+        CollectionJobIterator([1.0, 2.0, 3.0]), Gated,
+        n_workers=1, tracker=tracker)
+    METRICS.reset()
+    out = {}
+
+    def run():
+        out["result"] = runner.run(max_wall_s=60.0)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    wid = runner.register_worker()          # grow while jobs are gated
+    gate.set()
+    t.join(timeout=60.0)
+    assert not t.is_alive() and out["result"] is not None
+    assert runner.n_workers == 2
+    assert wid in tracker.workers()
+    snap = METRICS.snapshot()
+    assert snap["counters"]["scaleout.wave_grows"] == 1
+
+
+# --------------------------------------------------------- rendering + chaos
+
+def test_render_elasticity_table():
+    from tools.metrics_dump import render_elasticity
+    snap = {"gauges": {"elastic.mesh_size": 6.0,
+                       "elastic.wave_size": 3.0,
+                       "elastic.resizes_total": 2.0,
+                       "elastic.reshard_seconds": 0.0123},
+            "counters": {"checkpoint.reshards": 2,
+                         "resilience.device_losses": 1,
+                         "scaleout.wave_shrinks": 1}}
+    table = render_elasticity(snap)
+    assert "elasticity" in table
+    for frag in ("6 chips", "3 workers", "reshard_restores", "device_losses"):
+        assert frag in table, frag
+    assert render_elasticity({"gauges": {}, "counters": {}}) is None
+
+
+def test_chaos_smoke_elastic_leg():
+    """The chaos plan's elastic leg holds on a fixed seed: run completes
+    on the resized mesh, losses inside the window, bundle emitted."""
+    from tools.chaos_smoke import run_elastic
+    result = run_elastic(13)
+    assert result["final_step"] == result["ref_step"]
+    assert result["loss_window"] <= 1e-5
+    assert result["mesh_resize_bundles"]

@@ -899,3 +899,52 @@ def test_qt01_quiet_on_helper_and_float_casts():
     """
     assert not lint(src, only="QT01",
                     path="deeplearning4j_tpu/serving/snippet.py")
+
+
+# --------------------------------------------------------------------------- EL01
+
+EL01_BAD = """
+    import jax
+    from jax.sharding import Mesh
+
+    def build():
+        m = Mesh(jax.devices(), ("dp",))
+        first_eight = jax.devices()[:8]
+        chip = jax.local_devices()[0]
+        return m, first_eight, chip
+"""
+
+EL01_GOOD = """
+    import jax
+    from deeplearning4j_tpu.parallel.mesh import elastic_mesh
+
+    def build(n):
+        return elastic_mesh(jax.devices()[:n])
+"""
+
+
+def test_el01_fires_on_raw_mesh_and_literal_device_slice():
+    findings = lint(EL01_BAD, only="EL01",
+                    path="deeplearning4j_tpu/parallel/snippet.py")
+    assert rules_hit(findings) == {"EL01"}
+    assert len(findings) == 3           # Mesh(...) + [:8] + [0]
+    findings = lint(EL01_BAD, only="EL01",
+                    path="deeplearning4j_tpu/resilience/snippet.py")
+    assert len(findings) == 3           # resilience/ is in scope too
+
+
+def test_el01_quiet_on_helpers_and_variable_slices():
+    """Variable-bounded slices are the sanctioned idiom: the width is a
+    parameter the caller re-derives after a resize (driver.py/dryrun.py)."""
+    assert not lint(EL01_GOOD, only="EL01",
+                    path="deeplearning4j_tpu/parallel/snippet.py")
+
+
+def test_el01_scoped_to_parallel_and_resilience():
+    """mesh.py is the one sanctioned construction site; trees outside
+    parallel/+resilience/ (tools, tests, serving) are out of scope."""
+    assert not lint(EL01_BAD, only="EL01",
+                    path="deeplearning4j_tpu/parallel/mesh.py")
+    assert not lint(EL01_BAD, only="EL01",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+    assert not lint(EL01_BAD, only="EL01", path="tools/snippet.py")

@@ -15,6 +15,13 @@ subsystem: ``serving.request`` submission faults and ``serving.decode``
 dispatch skips, asserting completions stay token-identical to the
 fault-free ``Transformer.sample`` reference.
 
+A third leg (``run_elastic``, replay with ``--elastic --seed N``) rolls
+the elasticity dice: ``mesh.shrink`` kills 1-3 chips mid-run (sometimes
+handed back via ``mesh.grow``, sometimes with the resharding restore
+itself failing once via ``checkpoint.reshard``) and asserts training
+finishes on the surviving mesh inside the documented loss window with a
+``mesh_resize`` flight bundle emitted (DESIGN.md §21).
+
 The deterministic tier-1 subset lives in ``tests/test_resilience.py`` and
 ``tests/test_serving.py`` (fixed plans, per-mechanism assertions); this
 tool exists to keep rolling the dice on plan *combinations* nobody
@@ -265,8 +272,135 @@ def run_serving(seed: int, kv_quant: str | None = None) -> dict:
     return result
 
 
+def run_elastic(seed: int) -> dict:
+    """Chaos leg for the elasticity tier (ISSUE 13): kill 1-3 chips out of
+    the dp=8 mesh mid-run (``mesh.shrink``), sometimes hand them back later
+    (``mesh.grow``), sometimes make the resharding restore itself fail once
+    (``checkpoint.reshard``), and assert the supervised run COMPLETES on
+    the surviving mesh with every recovered loss inside the documented
+    elastic window (DESIGN.md §21: |loss - ref| <= 1e-5 across dp widths —
+    psum association order changes with the width, so cross-width parity
+    is a window, not bitwise) and a ``mesh_resize`` flight bundle emitted.
+    """
+    import pathlib
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.observability import FLIGHTREC, METRICS
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import DataParallelTrainer, elastic_mesh
+    from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.resilience import (
+        FaultSpec, RetryPolicy, TrainingSupervisor, inject_faults)
+
+    rng = random.Random(seed + 2)
+    observability.enable()
+    METRICS.reset()
+
+    w_true = np.asarray([1.0, -2.0, 0.5], np.float32)
+    xs = np.asarray(jax.random.normal(jax.random.key(3),
+                                      (N_BATCHES * BATCH, 3)))
+    ys = xs @ w_true
+
+    class Batch:
+        def __init__(self, x, y):
+            self.features, self.labels = x, y
+
+    data = [Batch(xs[i * BATCH:(i + 1) * BATCH],
+                  ys[i * BATCH:(i + 1) * BATCH]) for i in range(N_BATCHES)]
+
+    def loss_fn(p, xb, yb, key=None):
+        return jax.numpy.mean(((xb @ p["w"]) - yb) ** 2)
+
+    stage = rng.choice([0, 1, 2, 3])
+    lost_chips = rng.randint(1, 3)
+    shrink_at = rng.randint(2, N_BATCHES - 2)
+
+    def factory(devices):
+        devs = devices if devices is not None else jax.devices()[:8]
+        return DataParallelTrainer(loss_fn, T.chain(T.momentum(0.9),
+                                                    T.sgd_lr(5e-2)),
+                                   mesh=elastic_mesh(devs), zero_stage=stage)
+
+    params = {"w": np.zeros(3, np.float32)}
+    t_ref = factory(None)
+    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1)
+
+    plan = [FaultSpec("mesh.shrink", at_step=shrink_at, kind=str(lost_chips))]
+    grow = rng.random() < 0.5
+    if grow:
+        plan.append(FaultSpec("mesh.grow", at_step=rng.randint(
+            shrink_at + 1, N_BATCHES - 1)))
+    if rng.random() < 0.5:
+        # the reshard itself dies once mid-flight; the supervisor's retry
+        # budget must absorb it
+        plan.append(FaultSpec("checkpoint.reshard", probability=1.0,
+                              max_fires=1))
+    with tempfile.TemporaryDirectory() as ckpt_dir, \
+            tempfile.TemporaryDirectory() as rec_dir:
+        old_dump_dir = FLIGHTREC.dump_dir
+        FLIGHTREC.dump_dir = pathlib.Path(rec_dir)
+        try:
+            mgr = CheckpointManager(ckpt_dir, keep=10)
+            with inject_faults(*plan, seed=seed):
+                sup = TrainingSupervisor(
+                    mgr, RetryPolicy(max_attempts=8, backoff_base_s=0.01),
+                    install_signal_handlers=False)
+                state, losses = sup.fit(factory, params, data, epochs=1,
+                                        checkpoint_every=2)
+            bundles = sorted(p.name for p in
+                             pathlib.Path(rec_dir).glob("flightrec-mesh_resize-*"))
+        finally:
+            FLIGHTREC.dump_dir = old_dump_dir
+
+    final_mesh = int(sup.trainer.mesh.devices.size)
+    by_step = sup.report.losses_by_step
+    window = max((abs(v - ref_losses[s - 1]) for s, v in by_step.items()),
+                 default=0.0)
+    counters = METRICS.snapshot()["counters"]
+    result = {
+        "seed": seed,
+        "zero_stage": stage,
+        "plan": [f"{s.site}:at={s.at_step},kind={s.kind}" for s in plan],
+        "final_step": int(state.step),
+        "ref_step": int(s_ref.step),
+        "final_mesh_size": final_mesh,
+        "mesh_sizes": sup.report.mesh_sizes,
+        "resizes": sup.report.resizes,
+        "loss_window": float(window),
+        "losses_recovered": len(by_step),
+        "losses_finite": all(math.isfinite(v) for v in losses),
+        "mesh_resize_bundles": bundles,
+        "reshard_restores": int(counters.get("checkpoint.reshards", 0)),
+        "faults_injected": {k: int(v) for k, v in counters.items()
+                            if k.startswith("faults.injected.")},
+    }
+    assert result["final_step"] == result["ref_step"], \
+        f"seed {seed}: elastic run stopped at step {result['final_step']}"
+    expect_mesh = 8 if grow else 8 - lost_chips
+    assert final_mesh == expect_mesh, \
+        f"seed {seed}: final mesh {final_mesh}, expected {expect_mesh}"
+    assert result["mesh_sizes"][0] == 8 - lost_chips, result["mesh_sizes"]
+    # the documented elastic window (DESIGN.md §21): cross-width psum order
+    # shifts float32 losses by O(1e-6); 1e-5 bounds it with margin
+    assert window <= 1e-5, f"seed {seed}: loss window {window:.3e} > 1e-5"
+    assert bundles, f"seed {seed}: no mesh_resize flight bundle emitted"
+    assert result["faults_injected"].get("faults.injected.mesh.shrink", 0) \
+        or result["faults_injected"], result
+    return result
+
+
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
+    if "--elastic" in argv:
+        # replay a single failing elastic draw
+        result = run_elastic(seed if seed is not None
+                             else random.SystemRandom().randrange(2 ** 31))
+        print(json.dumps(result))
+        return 0
     if "--stage" in argv:
         # replay a single failing (seed, stage) draw
         stage = int(argv[argv.index("--stage") + 1])
@@ -283,6 +417,7 @@ def main(argv: list[str]) -> int:
         stage: run(base + stage, zero_stage=stage) for stage in (1, 2, 3)}
     result["serving"] = run_serving(base)
     result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
+    result["elastic"] = run_elastic(base)
     print(json.dumps(result))
     return 0
 

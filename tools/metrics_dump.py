@@ -186,6 +186,35 @@ def render_router(snap: dict) -> str | None:
     return "\n\n".join(out)
 
 
+def render_elasticity(snap: dict) -> str | None:
+    """Elastic-training tier (ISSUE 13): current mesh width, topology
+    resize count, last reshard wall-clock, scaleout wave size, and the
+    injected shrink/grow chaos fires that exercised them.  Returns None
+    when the job published no ``elastic.*`` gauges (fixed-topology jobs)."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    rows = []
+    if "elastic.mesh_size" in gauges:
+        rows.append(("mesh_size", f"{gauges['elastic.mesh_size']:.0f} chips"))
+    if "elastic.wave_size" in gauges:
+        rows.append(("wave_size", f"{gauges['elastic.wave_size']:.0f} workers"))
+    if "elastic.resizes_total" in gauges:
+        rows.append(("resizes_total", f"{gauges['elastic.resizes_total']:.0f}"))
+    if "elastic.reshard_seconds" in gauges:
+        rows.append(("reshard_seconds", _fmt_s(gauges["elastic.reshard_seconds"])))
+    for name, label in (("checkpoint.reshards", "reshard_restores"),
+                        ("resilience.device_losses", "device_losses"),
+                        ("scaleout.wave_shrinks", "wave_shrinks"),
+                        ("scaleout.wave_grows", "wave_grows"),
+                        ("faults.injected.mesh.shrink", "injected mesh.shrink"),
+                        ("faults.injected.mesh.grow", "injected mesh.grow")):
+        if name in counters:
+            rows.append((label, f"{counters[name]:.0f}"))
+    if not rows:
+        return None
+    return _rows("elasticity (topology changes)", rows, ("metric", "value"))
+
+
 def render_utilization(snap: dict) -> str | None:
     """MFU / memory-bandwidth gauges from the analytic cost model
     (``observability.cost``): published by the trainer, the decode loop
@@ -207,7 +236,8 @@ def render_metrics(snap: dict) -> str:
     if state_mem is not None:
         parts.append(state_mem)
     for section in (render_serving(snap), render_kv_capacity(snap),
-                    render_router(snap), render_utilization(snap)):
+                    render_router(snap), render_elasticity(snap),
+                    render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(

@@ -4,7 +4,8 @@ the benchmark contract — bench.py remains the single source of truth; this
 script only informs which knobs bench.py should default to.
 
 Usage: python tools/tune_tpu.py
-           post|pallas|ablate|resnet_ablate|resnet_trace|bert|resnet|flash
+           post|pallas|zero|kv|elastic|ablate|resnet_ablate|resnet_trace|
+           bert|resnet|flash
 """
 import json
 import os
@@ -572,9 +573,76 @@ def zero_battery(iters=12, d=4096, batch=64):
                    "train.opt_state_bytes.device.")}
 
 
+def elastic_battery(iters=5, d=4096, steps=3):
+    """Elasticity rows (ISSUE 13): reshard wall-clock per zero stage and
+    (save_dp -> restore_dp) direction — save a checkpoint at one dp width,
+    restore it at another through the resharding path, and time the
+    restore.  On CPU the widths are virtual-device halves of the host
+    mesh; on real chips this is the battery the owed ROADMAP-item-2
+    hardware run measures resharding cost with (the number that prices a
+    live shrink/grow against simply restarting).  Yields JSONL row dicts
+    like ``zero_battery``."""
+    import tempfile
+
+    import jax
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import (CheckpointManager,
+                                             DataParallelTrainer, elastic_mesh)
+
+    observability.enable()
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        yield {"battery": "elastic", "skipped": f"{n_dev} device(s)"}
+        return
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n_dev * 8, d)).astype(np.float32)
+    y = rng.normal(size=(n_dev * 8, 1)).astype(np.float32)
+
+    def loss_fn(p, xb, yb, key=None):
+        return ((xb @ p["w"] - yb) ** 2).mean()
+
+    def mk(width, stage):
+        return DataParallelTrainer(
+            loss_fn, T.adam(1e-3),
+            mesh=elastic_mesh(jax.devices()[:width]), zero_stage=stage)
+
+    params = {"w": np.zeros((d, 1), np.float32)}
+    for stage in (0, 1, 2, 3):
+        for save_dp, restore_dp in ((n_dev, n_dev // 2), (n_dev // 2, n_dev)):
+            with tempfile.TemporaryDirectory() as ckpt_dir:
+                mgr = CheckpointManager(ckpt_dir)
+                src = mk(save_dp, stage)
+                state = src.init_state(params)
+                for _ in range(steps):
+                    state, lazy = src.step(state, x, y)
+                src.checkpoint(state, mgr)
+                dst = mk(restore_dp, stage)
+                tmpl = dst.init_state(params)
+                times = []
+                for _ in range(iters):
+                    METRICS.reset()
+                    t0 = time.perf_counter()
+                    restored = dst.restore(tmpl, mgr)
+                    jax.block_until_ready((restored.params, restored.tstate))
+                    times.append(time.perf_counter() - t0)
+                g = METRICS.snapshot()["gauges"]
+                yield {"battery": "elastic", "zero_stage": stage,
+                       "save_dp": save_dp, "restore_dp": restore_dp, "d": d,
+                       "median_ms": round(_median(times) * 1e3, 3),
+                       "reshard_seconds_gauge": g.get("elastic.reshard_seconds")}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "bert"
     out = []
+    if which == "elastic":
+        # reshard cost battery: wall-clock to restore a checkpoint across
+        # dp widths, per zero stage (the elastic tier's hardware row)
+        for row in elastic_battery():
+            print(json.dumps(row), flush=True)
+        return
     if which == "zero":
         for row in zero_battery():
             print(json.dumps(row), flush=True)
