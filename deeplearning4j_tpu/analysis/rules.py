@@ -1,4 +1,4 @@
-"""The graftlint rule set — seventeen hazard classes from this repo's history.
+"""The graftlint rule set — eighteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -48,6 +48,10 @@
 |       | helpers in trainer/supervisor code — a raw `Mesh(...)` or a      |
 |       | `jax.devices()[<literal>]` slice hard-codes a device set the     |
 |       | elastic resize path (shrink/grow/reshard) cannot rebuild         |
+| OB02  | literal metric name passed to `METRICS.increment/gauge/          |
+|       | observe_time/time` that is missing from the documented metrics   |
+|       | tables (README.md / DESIGN.md) — undocumented names drift and    |
+|       | dashboards silently scrape nothing                               |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -57,6 +61,8 @@ the committed baseline with a justification.
 from __future__ import annotations
 
 import ast
+import pathlib
+import re
 from collections import Counter
 from typing import Iterator
 
@@ -1355,3 +1361,128 @@ class ElasticMeshConstructionRule(Rule):
                        and isinstance(b.value, int)
                        for b in (sl.lower, sl.upper))
         return False
+
+
+@register
+class UndocumentedMetricNameRule(Rule):
+    """OB02 — a metric name absent from the documented metrics tables.
+
+    Every scrape consumer (``metrics_dump``, the perf gate, the SLO
+    evaluator, dashboards) binds to metric names by string; PRs 9-13
+    each hand-patched a name that drifted from the docs after the fact.
+    This rule closes the loop at lint time: a literal first argument to
+    ``METRICS.increment/gauge/observe_time/observe_many/time`` (or the
+    same mutators on a ``registry``) must appear in a metrics table row
+    of ``README.md``/``DESIGN.md`` — rows shaped
+    ``| `name` | counter/gauge/timer | description |``.  Documented rows
+    may carry ``<placeholder>``/``{placeholder}``/``*`` suffixes
+    (``faults.injected.<site>``): they match any name sharing the
+    literal prefix.  F-strings and string concatenations are checked by
+    their leading literal against those wildcard rows; names with no
+    leading literal at all are runtime-composed and out of scope.
+
+    Blind spots: names built through variables or ``str.join``; a
+    mutator reached through a receiver not named ``METRICS``/
+    ``registry``; a too-short f-string prefix that several wildcard
+    rows cover.  Silence a deliberately undocumented (e.g. test-only)
+    name with ``# graftlint: disable=OB02`` plus the reason.
+    """
+
+    id = "OB02"
+    title = "metric name missing from the documented metrics tables"
+
+    _MUTATORS = {"increment", "gauge", "observe_time", "observe_many",
+                 "time"}
+    _RECEIVERS = {"METRICS", "registry"}
+    _DOC_FILES = ("README.md", "DESIGN.md")
+    _ROW = re.compile(
+        r"\s*\|\s*`([^`]+)`\s*\|\s*(?:counter|gauge|timer|histogram)s?\b")
+    _cache: tuple[frozenset, tuple] | None = None
+    _override: tuple[frozenset, tuple] | None = None
+
+    # ------------------------------------------------------- documented set
+    @classmethod
+    def set_documented(cls, names) -> None:
+        """Test hook: replace the parsed doc tables (None restores)."""
+        cls._override = None if names is None else cls._split(names)
+
+    @staticmethod
+    def _split(names) -> tuple[frozenset, tuple]:
+        exact, prefixes = set(), []
+        for n in names:
+            m = re.search(r"[<{*]", n)
+            if m:
+                prefixes.append(n[:m.start()])
+            else:
+                exact.add(n)
+        return frozenset(exact), tuple(prefixes)
+
+    @classmethod
+    def documented(cls) -> tuple[frozenset, tuple]:
+        if cls._override is not None:
+            return cls._override
+        if cls._cache is None:
+            root = pathlib.Path(__file__).resolve().parents[2]
+            names: list[str] = []
+            for fn in cls._DOC_FILES:
+                p = root / fn
+                if p.exists():
+                    for line in p.read_text().splitlines():
+                        m = cls._ROW.match(line)
+                        if m:
+                            names.append(m.group(1))
+            cls._cache = cls._split(names)
+        return cls._cache
+
+    # --------------------------------------------------------------- check
+    @staticmethod
+    def _literal_name(arg) -> tuple[str | None, bool]:
+        """(name, is_prefix_only): a Constant is the full name; an
+        f-string / ``"lit" + var`` concat yields its leading literal."""
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value, False
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            head = arg.values[0]
+            if isinstance(head, ast.Constant) and isinstance(head.value, str) \
+                    and head.value:
+                return head.value, True
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                and isinstance(arg.left, ast.Constant) \
+                and isinstance(arg.left.value, str) and arg.left.value:
+            return arg.left.value, True
+        return None, False
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        exact, prefixes = self.documented()
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._MUTATORS):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            if (last_segment(recv) or recv) not in self._RECEIVERS:
+                continue
+            arg = node.args[0] if node.args else None
+            if arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        arg = kw.value
+            if arg is None:
+                continue
+            name, prefix_only = self._literal_name(arg)
+            if name is None:
+                continue
+            if prefix_only:
+                if any(name.startswith(p) or p.startswith(name)
+                       for p in prefixes):
+                    continue
+            elif name in exact or any(name.startswith(p) for p in prefixes):
+                continue
+            yield self.finding(
+                module, node,
+                f"metric name `{name}{'…' if prefix_only else ''}` is not "
+                "in the documented metrics tables (README.md/DESIGN.md) — "
+                "scrape consumers bind to names by string, so undocumented "
+                "names drift silently; add a "
+                "`| `name` | kind | description |` row (wildcard "
+                "placeholders allowed) or silence with a reason")

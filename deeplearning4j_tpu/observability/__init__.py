@@ -13,6 +13,13 @@ The production observability layer (grown from the seed
   compiled signature; live ``*.mfu`` / ``*.mbu`` gauges (``cost``)
 - ``FLIGHTREC`` — bounded rings of recent spans/metric deltas/chaos fires,
   dumped to a JSON bundle on failure triggers (``flightrec``)
+- ``TimeSeriesStore`` — background sampler turning the registry into
+  bounded per-series rings + JSONL history (``timeseries``)
+- ``GoodputTracker`` — wall-clock state accounting for supervised runs:
+  productive/checkpoint/restore/rollback/stall/drain (``goodput``)
+- ``SLObjective``/``SLOEvaluator`` — rolling-window objectives with
+  multi-window error-budget burn rates; breaches dump flightrec bundles
+  and publish ``slo.burn_rate.*`` (``slo``)
 - ``StatusServer`` — ``/healthz`` ``/metrics`` ``/metrics.prom`` ``/status``
 - ``sample_device_memory`` — per-device HBM gauges (no-op gauge on
   backends without memory stats)
@@ -32,13 +39,19 @@ from .metrics import (
     MetricsRegistry,
     StepTimer,
 )
+from .goodput import GoodputTracker
 from .server import StatusServer
+from .slo import SLObjective, SLOEvaluator
+from .slo import default_serving_objectives, default_training_objectives
+from .timeseries import TimeSeriesStore
 from .tracing import TRACER, Tracer, profiler_trace, span
 
 __all__ = [
     "COSTS", "CostInfo", "CostModel", "DEFAULT_TIME_BUCKETS", "FLIGHTREC",
-    "FlightRecorder", "Histogram", "METRICS", "MetricsRegistry",
-    "NOOP_SPAN", "StatusServer", "StepTimer", "TRACER", "Tracer",
+    "FlightRecorder", "GoodputTracker", "Histogram", "METRICS",
+    "MetricsRegistry", "NOOP_SPAN", "SLOEvaluator", "SLObjective",
+    "StatusServer", "StepTimer", "TRACER", "TimeSeriesStore", "Tracer",
+    "default_serving_objectives", "default_training_objectives",
     "disable", "enable", "enabled", "profiler_trace",
     "sample_device_memory", "sample_state_bytes", "span", "trace",
 ]

@@ -16,6 +16,7 @@ before taking the lock.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import defaultdict, deque
@@ -32,8 +33,12 @@ DEFAULT_TIME_BUCKETS: tuple[float, ...] = (
 # Raw-value window per histogram for percentile estimation.  Percentiles are
 # over the most recent WINDOW observations (a ring buffer), which is what a
 # step-time dashboard wants anyway; bucket counts/sum/count remain exact
-# over the full lifetime.
-WINDOW = 4096
+# over the full lifetime.  Evicted observations are counted per histogram
+# and surfaced as the synthetic ``metrics.dropped_samples`` counter in
+# ``snapshot()``/``to_prometheus()`` so a long run can see how much raw
+# history its percentiles stand on.  Env-tunable for long soak runs.
+ENV_HIST_WINDOW = "DL4J_TPU_HIST_WINDOW"
+WINDOW = max(16, int(os.environ.get(ENV_HIST_WINDOW, "4096") or "4096"))
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -50,18 +55,23 @@ class Histogram:
     Not internally locked: the owning registry serializes access.
     """
 
-    __slots__ = ("buckets", "bucket_counts", "count", "total", "values")
+    __slots__ = ("buckets", "bucket_counts", "count", "total", "values",
+                 "dropped")
 
-    def __init__(self, buckets: Iterable[float] = DEFAULT_TIME_BUCKETS):
+    def __init__(self, buckets: Iterable[float] = DEFAULT_TIME_BUCKETS,
+                 window: int | None = None):
         self.buckets = tuple(sorted(buckets))
         self.bucket_counts = [0] * len(self.buckets)  # cumulative on render
         self.count = 0
         self.total = 0.0
-        self.values: deque[float] = deque(maxlen=WINDOW)
+        self.values: deque[float] = deque(maxlen=window or WINDOW)
+        self.dropped = 0  # raw values evicted from the percentile window
 
     def observe(self, value: float) -> None:
         self.count += 1
         self.total += value
+        if len(self.values) == self.values.maxlen:
+            self.dropped += 1
         self.values.append(value)
         for i, ub in enumerate(self.buckets):
             if value <= ub:
@@ -87,6 +97,7 @@ class Histogram:
             "p95_s": _percentile(vals, 0.95),
             "p99_s": _percentile(vals, 0.99),
             "max_s": vals[-1] if vals else float("nan"),
+            "dropped": self.dropped,
         }
 
 
@@ -205,8 +216,16 @@ class MetricsRegistry:
     # ------------------------------------------------------------- export
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
+            counters = dict(self.counters)
+            # Synthetic render-time counter: raw observations evicted from
+            # percentile windows.  Computed here (not incremented from
+            # inside Histogram.observe, which already runs under this
+            # non-reentrant lock) so it costs nothing on the observe path.
+            dropped = sum(h.dropped for h in self.timers.values())
+            if dropped:
+                counters["metrics.dropped_samples"] = float(dropped)
             return {
-                "counters": dict(self.counters),
+                "counters": counters,
                 "gauges": dict(self.gauges),
                 "timers": {k: h.summary() for k, h in self.timers.items()
                            if h.count},
@@ -220,12 +239,16 @@ class MetricsRegistry:
         """
         lines: list[str] = []
         with self._lock:
-            for name in sorted(self.counters):
+            counters = dict(self.counters)
+            dropped = sum(h.dropped for h in self.timers.values())
+            if dropped:
+                counters["metrics.dropped_samples"] = float(dropped)
+            for name in sorted(counters):
                 pn = _prom_name(name)
                 if not pn.endswith("_total"):
                     pn += "_total"
                 lines.append(f"# TYPE {pn} counter")
-                lines.append(f"{pn} {_prom_float(self.counters[name])}")
+                lines.append(f"{pn} {_prom_float(counters[name])}")
             for name in sorted(self.gauges):
                 pn = _prom_name(name)
                 lines.append(f"# TYPE {pn} gauge")

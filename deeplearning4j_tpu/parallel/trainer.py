@@ -652,7 +652,7 @@ class DataParallelTrainer:
             async_dispatch: bool = True, resolve_every: int = 32,
             prefetch_size: int = 2, nan_guard: bool = False,
             should_stop: Callable[[int], bool] | None = None,
-            extra_skip: int = 0,
+            extra_skip: int = 0, goodput=None,
             ) -> tuple[TrainState, list[float]]:
         """Run ``epochs`` passes over ``data``, counting steps from
         ``state.step`` — so a state restored from a checkpoint continues
@@ -678,26 +678,46 @@ class DataParallelTrainer:
         after every dispatch — True drains the ring, writes an emergency
         checkpoint and returns (preemption handling); ``extra_skip`` drops
         that many additional stream batches past the resume cursor (the
-        supervisor's divergence batch-window skip)."""
+        supervisor's divergence batch-window skip); ``goodput`` is an
+        optional :class:`~..observability.goodput.GoodputTracker` the loop
+        marks with restore/checkpoint/stall/drain intervals (``None`` —
+        the default, and always the case when observability is off — adds
+        zero per-step work: no clock reads, no allocations)."""
         n_known = len(data) if hasattr(data, "__len__") else -1
         self._nan_guard = nan_guard
         with trace.span("trainer.fit", epochs=epochs, n_batches=n_known,
                         router=self.router):
             if checkpoint_manager is not None and resume \
                     and checkpoint_manager.latest_step() is not None:
+                if goodput is not None:
+                    goodput.transition("restore")
                 try:
                     state = self.restore(state, checkpoint_manager)
                 except FileNotFoundError:
                     # every on-disk checkpoint failed verification — train
                     # from scratch rather than load corrupt state
                     METRICS.increment("checkpoint.no_valid_restore")
+            if goodput is not None:
+                # whatever the caller left us in (rollback backoff, resize
+                # restore, drain), dispatching steps is productive time
+                goodput.transition("productive")
             handles: list[LazyLoss] = []
+            draining = False
             # steady state runs under the transfer guard: every host<->device
             # crossing in the loop must be an explicit device_put/device_get
             # (opt out via DL4J_TPU_TRANSFER_GUARD=0; see analysis.runtime)
             with hot_loop_guard():
-                for x, y, n_valid, bucket in self._host_stream(
-                        data, epochs, state.step + extra_skip, prefetch_size):
+                stream = iter(self._host_stream(
+                    data, epochs, state.step + extra_skip, prefetch_size))
+                while True:
+                    if goodput is not None:
+                        t_fetch = time.perf_counter()
+                    try:
+                        x, y, n_valid, bucket = next(stream)
+                    except StopIteration:
+                        break
+                    if goodput is not None:
+                        goodput.data_wait(t_fetch, time.perf_counter())
                     state, lazy = self._dispatch(state, x, y, n_valid, bucket)
                     handles.append(lazy)
                     if not async_dispatch:
@@ -706,6 +726,11 @@ class DataParallelTrainer:
                         self._resolve_pending()
                     if should_stop is not None and should_stop(state.step):
                         # preemption: drain in-flight steps, snapshot, leave
+                        # (goodput: everything from the stop signal to the
+                        # return — including the emergency save — is drain)
+                        if goodput is not None:
+                            goodput.transition("drain")
+                        draining = True
                         self._resolve_pending()
                         if checkpoint_manager is not None:
                             self.checkpoint(state, checkpoint_manager)
@@ -713,11 +738,19 @@ class DataParallelTrainer:
                         break
                     if (checkpoint_manager is not None and checkpoint_every > 0
                             and state.step % checkpoint_every == 0):
-                        self.checkpoint(state, checkpoint_manager)
+                        if goodput is not None:
+                            with goodput.phase("checkpoint"):
+                                self.checkpoint(state, checkpoint_manager)
+                        else:
+                            self.checkpoint(state, checkpoint_manager)
                 self._resolve_pending()
             losses = [h.value() for h in handles]
             if checkpoint_manager is not None and losses:
-                self.checkpoint(state, checkpoint_manager)
+                if goodput is not None and not draining:
+                    with goodput.phase("checkpoint"):
+                        self.checkpoint(state, checkpoint_manager)
+                else:
+                    self.checkpoint(state, checkpoint_manager)
         sample_device_memory()  # HBM gauges; no-op on CPU / when disabled
         return state, losses
 

@@ -42,6 +42,8 @@ import time
 from typing import Any, Callable
 
 from ..observability import FLIGHTREC, METRICS, trace
+from ..observability import enabled as _obs_enabled
+from ..observability.goodput import GoodputTracker
 from .faults import FAULTS, DeviceLossError, DivergenceError, TrainingPreempted
 
 
@@ -95,6 +97,10 @@ class SupervisorReport:
     # whose attempt aborted mid-window are absent (their losses died with
     # the pending ring), so consumers must align by step, not position
     losses_by_step: dict = dataclasses.field(default_factory=dict)
+    # GoodputTracker.report() of the run (None when observability is off):
+    # wall-clock classified into productive/checkpoint/restore/rollback/
+    # stall/drain, summing to wall-clock by construction
+    goodput: dict | None = None
 
 
 class TrainingSupervisor:
@@ -124,6 +130,7 @@ class TrainingSupervisor:
         self._grow_requested = False
         self._lost_devices: list = []  # quarantined chips awaiting re-admission
         self.trainer = None  # the live trainer (rebuilt on every resize)
+        self.goodput: GoodputTracker | None = None  # set per fit() run
         self._old_handlers: dict[int, Any] = {}
 
     # ------------------------------------------------------------- signals
@@ -240,6 +247,14 @@ class TrainingSupervisor:
         extra_skip = 0
         self._preempt_requested = False
         self._grow_requested = False
+        # Goodput accounting (DESIGN.md §22): the supervisor owns the
+        # tracker, the trainer marks restore/checkpoint/stall/drain, the
+        # exception arms below mark rollback/restore.  None when
+        # observability is off — the fit loop then does zero extra work.
+        gp = fit_kwargs.pop("goodput", None)
+        if gp is None and _obs_enabled():
+            gp = GoodputTracker()
+        self.goodput = gp
         self._install_signals()
         try:
             with trace.span("resilience.supervised_fit", epochs=epochs):
@@ -257,8 +272,11 @@ class TrainingSupervisor:
                             checkpoint_every=checkpoint_every, resume=True,
                             nan_guard=self.nan_guard,
                             should_stop=self._should_stop,
-                            extra_skip=extra_skip, **fit_kwargs)
+                            extra_skip=extra_skip, goodput=gp,
+                            **fit_kwargs)
                     except DivergenceError as e:
+                        if gp is not None:
+                            gp.transition("rollback")
                         trainer.abort()
                         rollbacks += 1
                         self.report.rollbacks += 1
@@ -287,6 +305,8 @@ class TrainingSupervisor:
                         # abrupt half of elasticity: chips died mid-step.
                         # The in-flight window is gone with them — drop it,
                         # rebuild from the survivors, reshard-resume.
+                        if gp is not None:
+                            gp.transition("restore")  # rebuild + reshard
                         trainer.abort()
                         METRICS.increment("resilience.device_losses")
                         if factory is None:
@@ -303,6 +323,8 @@ class TrainingSupervisor:
                         self.trainer = trainer
                         continue
                     except self.policy.retry_on as e:
+                        if gp is not None:
+                            gp.transition("rollback")  # incl. backoff sleep
                         trainer.abort()
                         streak += 1
                         self.report.retries += 1
@@ -330,6 +352,8 @@ class TrainingSupervisor:
                                         if id(d) not in have]
                             self._lost_devices = []
                             if regained:
+                                if gp is not None:
+                                    gp.transition("restore")
                                 trainer = self._resize(
                                     factory, old, old + regained,
                                     state.step, "grow")
@@ -347,4 +371,6 @@ class TrainingSupervisor:
                         raise exc
                     return state, [by_step[s] for s in sorted(by_step)]
         finally:
+            if gp is not None:
+                self.report.goodput = gp.finish()
             self._restore_signals()

@@ -948,3 +948,76 @@ def test_el01_scoped_to_parallel_and_resilience():
     assert not lint(EL01_BAD, only="EL01",
                     path="deeplearning4j_tpu/serving/snippet.py")
     assert not lint(EL01_BAD, only="EL01", path="tools/snippet.py")
+
+
+# --------------------------------------------------------------------------- OB02
+
+OB02_BAD = """
+    from deeplearning4j_tpu.observability import METRICS
+    def work(registry):
+        METRICS.increment("serving.bogus_counter")
+        registry.gauge("made.up.gauge", 1.0)
+        with METRICS.time("undocumented.timer"):
+            pass
+"""
+
+OB02_GOOD = """
+    from deeplearning4j_tpu.observability import METRICS
+    def work(site, registry):
+        METRICS.increment("serving.requests")
+        METRICS.increment(f"faults.injected.{site}")
+        METRICS.gauge("goodput.seconds." + "stall", 1.0)
+        registry.gauge("goodput.fraction", 0.5)
+        name = compute_name()
+        METRICS.increment(name)          # runtime-composed: out of scope
+        other.increment("not.a.registry.recv")
+"""
+
+
+def _ob02(source, documented):
+    from deeplearning4j_tpu.analysis.rules import UndocumentedMetricNameRule
+    UndocumentedMetricNameRule.set_documented(documented)
+    try:
+        return lint(source, only="OB02",
+                    path="deeplearning4j_tpu/serving/snippet.py")
+    finally:
+        UndocumentedMetricNameRule.set_documented(None)
+
+
+def test_ob02_fires_on_undocumented_names():
+    findings = _ob02(OB02_BAD, ["serving.requests"])
+    assert rules_hit(findings) == {"OB02"}
+    assert len(findings) == 3            # increment + gauge + time
+    assert any("serving.bogus_counter" in f.message for f in findings)
+
+
+def test_ob02_quiet_on_documented_and_wildcard_names():
+    documented = ["serving.requests", "faults.injected.<site>",
+                  "goodput.seconds.<state>", "goodput.fraction"]
+    assert not _ob02(OB02_GOOD, documented)
+
+
+def test_ob02_fstring_prefix_checked_against_wildcards():
+    """An f-string's leading literal must overlap a wildcard row; a
+    fully documented exact row also covers names built under it."""
+    src = """
+        from deeplearning4j_tpu.observability import METRICS
+        def work(rule):
+            METRICS.gauge(f"graftlint.violations.{rule}", 1.0)
+    """
+    assert not _ob02(src, ["graftlint.violations.<rule>"])
+    findings = _ob02(src, ["serving.requests"])
+    assert len(findings) == 1
+    assert "graftlint.violations." in findings[0].message
+
+
+def test_ob02_package_tables_cover_the_tree():
+    """The committed README/DESIGN tables must cover every name the
+    package emits — the zero-baseline contract for this rule."""
+    from deeplearning4j_tpu.analysis import Analyzer, active
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    analyzer = Analyzer(rules=[all_rules()["OB02"]], root=repo)
+    findings = analyzer.analyze_paths(
+        [os.path.join(repo, "deeplearning4j_tpu")])
+    assert [f for f in active(findings)] == []

@@ -22,6 +22,12 @@ itself failing once via ``checkpoint.reshard``) and asserts training
 finishes on the surviving mesh inside the documented loss window with a
 ``mesh_resize`` flight bundle emitted (DESIGN.md §21).
 
+Every supervised leg also audits the goodput accounting (DESIGN.md §22):
+the run's state timeline must be exhaustive, sum to independently
+measured wall-clock within 1%, and ``goodput.fraction`` must strictly
+decrease versus the no-fault reference of the same seed — faults cost
+wall-clock, and the accounting has to see exactly how much.
+
 The deterministic tier-1 subset lives in ``tests/test_resilience.py`` and
 ``tests/test_serving.py`` (fixed plans, per-mechanism assertions); this
 tool exists to keep rolling the dice on plan *combinations* nobody
@@ -35,9 +41,46 @@ import math
 import random
 import sys
 import tempfile
+import time
 
 N_BATCHES = 8
 BATCH = 8
+
+
+def _goodput_check(sup, ref_report: dict, measured_wall_s: float,
+                   seed: int) -> dict:
+    """Shared goodput acceptance (ISSUE 14): the supervised run's state
+    timeline must be exhaustive over the documented states, sum to the
+    independently measured wall-clock within 1%, and its productive
+    fraction must be STRICTLY below the no-fault reference run of the
+    same seed (faults cost wall-clock; the accounting must see it)."""
+    from deeplearning4j_tpu.observability.goodput import STATES
+
+    rep = sup.report.goodput
+    assert rep is not None, f"seed {seed}: supervisor produced no goodput report"
+    assert set(rep["states"]) <= set(STATES), rep["states"]
+    acct, wall = rep["accounted_seconds"], rep["wall_seconds"]
+    # timeline intervals are contiguous by construction: they must cover
+    # the tracker's own wall exactly, and the tracker's wall must agree
+    # with the clock we ran around the whole supervised fit
+    assert abs(acct - wall) <= max(0.01 * wall, 1e-6), \
+        f"seed {seed}: goodput timeline {acct:.4f}s != wall {wall:.4f}s"
+    assert abs(acct - measured_wall_s) <= max(0.01 * measured_wall_s, 0.02), \
+        (f"seed {seed}: goodput timeline {acct:.4f}s vs measured "
+         f"wall {measured_wall_s:.4f}s (>1%)")
+    overhead = sum(v for k, v in rep["seconds"].items() if k != "productive")
+    assert rep["fraction"] < ref_report["fraction"], \
+        (f"seed {seed}: goodput fraction {rep['fraction']:.4f} did not "
+         f"decrease vs no-fault {ref_report['fraction']:.4f}")
+    assert overhead > 0.0, f"seed {seed}: fault run accounted no overhead"
+    return {
+        "fraction": rep["fraction"],
+        "ref_fraction": ref_report["fraction"],
+        "seconds": {k: round(v, 6) for k, v in rep["seconds"].items()},
+        "states": rep["states"],
+        "wall_seconds": rep["wall_seconds"],
+        "measured_wall_seconds": measured_wall_s,
+    }
 
 
 def _draw_plan(rng: random.Random):
@@ -103,8 +146,12 @@ def run(seed: int | None = None, zero_stage: int = 0) -> dict:
     # the fault-free reference always runs REPLICATED (stage 0): the chaos
     # claim under ZeRO is recovery parity against classic numerics, not
     # just against another sharded run
+    from deeplearning4j_tpu.observability import GoodputTracker
     t_ref = new_trainer(stage=0)
-    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1)
+    gp_ref = GoodputTracker()
+    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1,
+                                  goodput=gp_ref)
+    ref_goodput = gp_ref.finish()
 
     plan = _draw_plan(rng)
     with tempfile.TemporaryDirectory() as ckpt_dir:
@@ -114,8 +161,10 @@ def run(seed: int | None = None, zero_stage: int = 0) -> dict:
                 mgr, RetryPolicy(max_attempts=8, backoff_base_s=0.01),
                 install_signal_handlers=False)
             trainer = new_trainer()
+            t_wall = time.monotonic()
             state, losses = sup.fit(trainer, params, data, epochs=1,
                                     checkpoint_every=2)
+            wall_s = time.monotonic() - t_wall
 
     # compare NATURAL layouts: under zero_stage=3 state.params are the
     # flat dp-sharded chunks, so collapse both sides via final_params
@@ -147,6 +196,7 @@ def run(seed: int | None = None, zero_stage: int = 0) -> dict:
         "faults_injected": {k: int(v) for k, v in counters.items()
                             if k.startswith("faults.injected.")},
         "corrupt_detected": int(counters.get("checkpoint.corrupt_detected", 0)),
+        "goodput": _goodput_check(sup, ref_goodput, wall_s, seed),
     }
     assert result["final_step"] == result["ref_step"], \
         f"seed {seed}: chaos run stopped at step {result['final_step']}"
@@ -326,8 +376,12 @@ def run_elastic(seed: int) -> dict:
                                    mesh=elastic_mesh(devs), zero_stage=stage)
 
     params = {"w": np.zeros(3, np.float32)}
+    from deeplearning4j_tpu.observability import GoodputTracker
     t_ref = factory(None)
-    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1)
+    gp_ref = GoodputTracker()
+    s_ref, ref_losses = t_ref.fit(t_ref.init_state(params), data, epochs=1,
+                                  goodput=gp_ref)
+    ref_goodput = gp_ref.finish()
 
     plan = [FaultSpec("mesh.shrink", at_step=shrink_at, kind=str(lost_chips))]
     grow = rng.random() < 0.5
@@ -349,8 +403,10 @@ def run_elastic(seed: int) -> dict:
                 sup = TrainingSupervisor(
                     mgr, RetryPolicy(max_attempts=8, backoff_base_s=0.01),
                     install_signal_handlers=False)
+                t_wall = time.monotonic()
                 state, losses = sup.fit(factory, params, data, epochs=1,
                                         checkpoint_every=2)
+                wall_s = time.monotonic() - t_wall
             bundles = sorted(p.name for p in
                              pathlib.Path(rec_dir).glob("flightrec-mesh_resize-*"))
         finally:
@@ -377,6 +433,7 @@ def run_elastic(seed: int) -> dict:
         "reshard_restores": int(counters.get("checkpoint.reshards", 0)),
         "faults_injected": {k: int(v) for k, v in counters.items()
                             if k.startswith("faults.injected.")},
+        "goodput": _goodput_check(sup, ref_goodput, wall_s, seed),
     }
     assert result["final_step"] == result["ref_step"], \
         f"seed {seed}: elastic run stopped at step {result['final_step']}"

@@ -6,9 +6,19 @@ Usage:
     python tools/metrics_dump.py --port 8787 [--host 127.0.0.1]
     python tools/metrics_dump.py --url http://10.0.0.3:8787
     python tools/metrics_dump.py --port 8787 --prom   # raw Prometheus text
+    python tools/metrics_dump.py --timeline ts_dir/   # offline: sparklines
+                                                      # from TimeSeriesStore
+                                                      # JSONL exports
+
+``--timeline`` takes a ``timeseries-*.jsonl`` file (or a directory of
+them, as written under ``DL4J_TPU_TS_DIR``) and needs no live server:
+each series renders as min/last/max plus a unicode sparkline of its
+recent samples.  ``--series SUBSTR`` filters the set.
 
 No dependencies beyond stdlib: talks to the endpoints
-``deeplearning4j_tpu.observability.StatusServer`` serves.
+``deeplearning4j_tpu.observability.StatusServer`` serves, and reads the
+``TimeSeriesStore`` JSONL format directly (torn final lines from a
+killed process are skipped, matching ``timeseries.read_back``).
 """
 
 from __future__ import annotations
@@ -230,6 +240,39 @@ def render_utilization(snap: dict) -> str | None:
                  ("gauge", "value"))
 
 
+def render_goodput(snap: dict) -> str | None:
+    """Goodput accounting + SLO burn rates (ISSUE 14): the wall-clock
+    split a finished ``GoodputTracker`` published, each state as seconds
+    and share-of-wall, plus every ``slo.burn_rate.*`` gauge the SLO
+    evaluator keeps live (>= 1.0 means the error budget burns faster
+    than the objective allows).  Returns None when the job published
+    neither (unsupervised or pre-goodput jobs)."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    out = []
+    wall = gauges.get("goodput.wall_seconds")
+    if wall is not None:
+        rows = [("fraction", f"{gauges.get('goodput.fraction', 0.0) * 100:.1f}%"),
+                ("wall", _fmt_s(wall))]
+        prefix = "goodput.seconds."
+        for k, v in sorted(gauges.items()):
+            if k.startswith(prefix):
+                share = v / wall * 100 if wall else 0.0
+                rows.append((k[len(prefix):], f"{_fmt_s(v)} ({share:.1f}%)"))
+        out.append(_rows("goodput (wall-clock accounting)", rows,
+                         ("state", "value")))
+    slo_rows = [(k[len("slo.burn_rate."):],
+                 f"{v:.2f}x" + ("  << BURNING" if v >= 1.0 else ""))
+                for k, v in sorted(gauges.items())
+                if k.startswith("slo.burn_rate.")]
+    if "slo.breaches" in counters:
+        slo_rows.append(("breaches", f"{counters['slo.breaches']:.0f}"))
+    if slo_rows:
+        out.append(_rows("slo (error-budget burn rates)", slo_rows,
+                         ("objective", "burn")))
+    return "\n\n".join(out) if out else None
+
+
 def render_metrics(snap: dict) -> str:
     parts = []
     state_mem = render_state_memory(snap)
@@ -237,7 +280,7 @@ def render_metrics(snap: dict) -> str:
         parts.append(state_mem)
     for section in (render_serving(snap), render_kv_capacity(snap),
                     render_router(snap), render_elasticity(snap),
-                    render_utilization(snap)):
+                    render_goodput(snap), render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(
@@ -279,6 +322,61 @@ def render_status(status: dict) -> str:
     return "\n".join(lines)
 
 
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float], width: int = 40) -> str:
+    vals = [v for v in values if v == v][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[4] * len(vals)
+    return "".join(
+        _SPARK[min(8, int((v - lo) / (hi - lo) * 8.999))] for v in vals)
+
+
+def _read_timeline(path: str) -> dict[str, list[tuple[float, float]]]:
+    """Merge ``timeseries-*.jsonl`` exports (one file or a directory)
+    into ``{series: [(t, value), ...]}``, skipping torn/partial lines —
+    the stdlib twin of ``timeseries.read_back_series``."""
+    import os
+    paths = ([os.path.join(path, f) for f in sorted(os.listdir(path))
+              if f.endswith(".jsonl")] if os.path.isdir(path) else [path])
+    series: dict[str, list[tuple[float, float]]] = {}
+    for p in paths:
+        with open(p, errors="replace") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue            # torn tail from a killed process
+                if not isinstance(rec, dict) or "series" not in rec:
+                    continue
+                t = float(rec.get("t", 0.0))
+                for name, v in rec["series"].items():
+                    series.setdefault(name, []).append((t, float(v)))
+    for rows in series.values():
+        rows.sort(key=lambda tv: tv[0])
+    return series
+
+
+def render_timeline(path: str, pattern: str = "") -> str:
+    series = _read_timeline(path)
+    names = sorted(n for n in series if pattern in n)
+    if not names:
+        return f"timeline: no series matching {pattern!r} in {path}"
+    rows = []
+    for name in names:
+        vals = [v for _, v in series[name]]
+        rows.append((name, len(vals), f"{min(vals):.6g}", f"{vals[-1]:.6g}",
+                     f"{max(vals):.6g}", _sparkline(vals)))
+    span = max(t for rs in series.values() for t, _ in rs) - \
+        min(t for rs in series.values() for t, _ in rs)
+    return _rows(f"timeline ({len(names)} series over {span:.1f}s)", rows,
+                 ("series", "n", "min", "last", "max", "recent"))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -286,8 +384,16 @@ def main(argv=None) -> int:
     ap.add_argument("--url", help="full base URL (overrides --host/--port)")
     ap.add_argument("--prom", action="store_true",
                     help="dump raw Prometheus text exposition instead")
+    ap.add_argument("--timeline", metavar="PATH",
+                    help="render TimeSeriesStore JSONL (file or dir) "
+                         "offline instead of scraping a server")
+    ap.add_argument("--series", default="",
+                    help="substring filter for --timeline series names")
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
+    if args.timeline:
+        print(render_timeline(args.timeline, args.series))
+        return 0
     if args.url:
         base = args.url.rstrip("/")
     elif args.port:

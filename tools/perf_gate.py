@@ -1,0 +1,243 @@
+"""Perf regression gate: diff a bench/smoke artifact against the committed
+trajectory and refuse silent regressions.
+
+The bench artifacts (``LAST_VALID_TPU_BENCH.json``, ``BENCH_r*.json``,
+smoke JSON lines) record point-in-time numbers, but nothing *compared*
+them — a 10% tokens/sec regression would land as just another artifact.
+This gate closes the loop:
+
+- ``BENCH_TRAJECTORY.json`` (committed at the repo root) holds the
+  accepted history: one entry per recorded run, each a flat
+  ``{series: value}`` dict plus provenance.
+- ``python tools/perf_gate.py [artifact]`` extracts the key series from
+  the artifact (tokens/sec, MFU, step time, TTFT p99, goodput fraction —
+  whichever are present) and compares each against the NEWEST trajectory
+  entry that has that series, direction-aware: higher-is-better series
+  fail below ``base * (1 - tolerance)``, lower-is-better above
+  ``base * (1 + tolerance)``.  A failure names the series, both values,
+  and the tolerance — no silent drift.
+- Entries are **device-scoped**: a CPU-fallback bench (``CPU_FALLBACK``
+  metric suffix / ``TFRT_CPU`` device) is never held to a TPU baseline
+  or vice versa.  Entries without a ``device`` tag match any artifact
+  (legacy), and an entry may carry its own ``tolerance`` — a shared-core
+  CPU baseline records a looser band than a quiet TPU one.
+- ``--record`` appends the artifact's series as a new trajectory entry
+  (after the gate passes; ``--force`` records anyway, for an accepted
+  regression with a reason).
+
+Running the gate twice on the same artifact is idempotent: equal values
+are within any tolerance.  An empty trajectory seeds itself from the
+first gated artifact (that run passes by definition and writes the
+baseline the next run is held to).
+
+Series are looked up through dotted paths with fallbacks, so the one gate
+reads bench artifacts (``value``/``extra.mfu``/``extra.step_ms.median``),
+chaos smoke results (``goodput.fraction``) and serving smoke results
+(``ttft_s.p99``) without format negotiation.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+DEFAULT_TRAJECTORY = _REPO_ROOT / "BENCH_TRAJECTORY.json"
+DEFAULT_ARTIFACT = _REPO_ROOT / "LAST_VALID_TPU_BENCH.json"
+DEFAULT_TOLERANCE = 0.05
+
+# (series, candidate dotted paths tried in order, direction)
+SERIES: tuple[tuple[str, tuple[str, ...], str], ...] = (
+    ("tokens_per_sec",
+     ("value", "tokens_per_sec", "extra.e2e_with_transfers.tokens_per_sec"),
+     "higher"),
+    ("mfu", ("extra.mfu", "mfu"), "higher"),
+    ("step_ms_median", ("extra.step_ms.median", "step_ms.median"), "lower"),
+    ("resnet_images_per_sec",
+     ("extra.resnet.images_per_sec_per_chip",), "higher"),
+    ("ttft_p99_s", ("ttft_s.p99", "serving.ttft.p99", "ttft_p99_s"), "lower"),
+    ("goodput_fraction",
+     ("goodput.fraction", "goodput_fraction"), "higher"),
+)
+
+DIRECTIONS = {name: direction for name, _, direction in SERIES}
+
+
+def _dig(obj, path: str):
+    for part in path.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj
+
+
+def extract(artifact: dict) -> dict[str, float]:
+    """Pull every known series present in the artifact (dotted-path
+    fallbacks; non-numeric hits are skipped, absences are not errors —
+    a serving artifact has no MFU and that is fine)."""
+    out: dict[str, float] = {}
+    for name, paths, _direction in SERIES:
+        for path in paths:
+            v = _dig(artifact, path)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[name] = float(v)
+                break
+    return out
+
+
+def extract_device(artifact: dict) -> str:
+    """Device class of the artifact: "cpu" or "tpu".  Bench lines carry
+    the compile device in ``extra.device`` and mark host fallbacks with a
+    ``_CPU_FALLBACK`` metric suffix; smoke artifacts carry neither and
+    are CPU runs by construction (tier-1 is a CPU mesh)."""
+    metric = str(artifact.get("metric", ""))
+    device = str(_dig(artifact, "extra.device") or artifact.get("device", ""))
+    if "CPU_FALLBACK" in metric or device.upper().startswith(("TFRT_CPU",
+                                                              "CPU")):
+        return "cpu"
+    if device or "tokens_per_sec" in metric:
+        return "tpu"
+    return "cpu"
+
+
+def load_trajectory(path: pathlib.Path) -> dict:
+    if path.exists():
+        with open(path) as f:
+            traj = json.load(f)
+        traj.setdefault("entries", [])
+        traj.setdefault("tolerance", DEFAULT_TOLERANCE)
+        traj.setdefault("series_tolerance", {})
+        return traj
+    return {"tolerance": DEFAULT_TOLERANCE, "series_tolerance": {},
+            "entries": []}
+
+
+def _baseline_for(traj: dict, series: str,
+                  device: str) -> tuple[float, dict] | None:
+    """Newest same-device trajectory entry carrying this series (entries
+    are appended, so scan from the end; entries without a ``device`` tag
+    match any artifact)."""
+    for entry in reversed(traj["entries"]):
+        if entry.get("device", device) != device:
+            continue
+        v = entry.get("series", {}).get(series)
+        if isinstance(v, (int, float)):
+            return float(v), entry
+    return None
+
+
+def gate(current: dict[str, float], traj: dict,
+         device: str = "cpu") -> tuple[list[str], list[str]]:
+    """Compare extracted series against the trajectory.  Returns
+    (failures, compared) — failure strings name series, values, and the
+    tolerance that was exceeded."""
+    failures: list[str] = []
+    compared: list[str] = []
+    for name, value in sorted(current.items()):
+        hit = _baseline_for(traj, name, device)
+        if hit is None:
+            continue
+        base, entry = hit
+        tol = float(entry.get("tolerance")
+                    or traj["series_tolerance"].get(name, traj["tolerance"]))
+        compared.append(name)
+        if DIRECTIONS[name] == "higher":
+            floor = base * (1.0 - tol)
+            if value < floor:
+                failures.append(
+                    f"{name}: {value:.6g} regressed below baseline "
+                    f"{base:.6g} - {tol:.0%} tolerance (floor {floor:.6g})")
+        else:
+            ceil = base * (1.0 + tol)
+            if value > ceil:
+                failures.append(
+                    f"{name}: {value:.6g} regressed above baseline "
+                    f"{base:.6g} + {tol:.0%} tolerance (ceiling {ceil:.6g})")
+    return failures, compared
+
+
+def record(traj: dict, series: dict[str, float], *, label: str,
+           source: str, device: str = "cpu",
+           tolerance: float | None = None) -> None:
+    entry = {
+        "label": label,
+        "source": source,
+        "device": device,
+        "series": {k: v for k, v in sorted(series.items())},
+    }
+    if tolerance is not None:
+        entry["tolerance"] = tolerance
+    traj["entries"].append(entry)
+
+
+def run(artifact_path: pathlib.Path, trajectory_path: pathlib.Path,
+        *, do_record: bool = False, force: bool = False,
+        label: str = "") -> dict:
+    with open(artifact_path) as f:
+        artifact = json.load(f)
+    current = extract(artifact)
+    if not current:
+        raise SystemExit(
+            f"perf_gate: no known series in {artifact_path} "
+            f"(looked for {', '.join(n for n, _, _ in SERIES)})")
+    device = extract_device(artifact)
+    traj = load_trajectory(trajectory_path)
+    seeded = not traj["entries"]
+    failures, compared = gate(current, traj, device)
+    if seeded or (do_record and (not failures or force)):
+        record(traj, current, label=label or artifact_path.name,
+               source=str(artifact_path.name), device=device)
+        with open(trajectory_path, "w") as f:
+            json.dump(traj, f, indent=2)
+            f.write("\n")
+    return {
+        "artifact": str(artifact_path),
+        "trajectory": str(trajectory_path),
+        "series": current,
+        "device": device,
+        "compared": compared,
+        "seeded": seeded,
+        "recorded": seeded or (do_record and (not failures or force)),
+        "tolerance": traj["tolerance"],
+        "failures": failures,
+        "ok": not failures,
+    }
+
+
+def main(argv: list[str]) -> int:
+    positional: list[str] = []
+    trajectory, label = DEFAULT_TRAJECTORY, ""
+    do_record = force = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--trajectory":
+            trajectory = pathlib.Path(argv[i + 1])
+            i += 2
+        elif a == "--label":
+            label = argv[i + 1]
+            i += 2
+        elif a == "--record":
+            do_record = True
+            i += 1
+        elif a == "--force":
+            force = True
+            i += 1
+        else:
+            positional.append(a)
+            i += 1
+    artifact = pathlib.Path(positional[0]) if positional else DEFAULT_ARTIFACT
+    result = run(artifact, trajectory,
+                 do_record=do_record, force=force, label=label)
+    print(json.dumps(result, indent=2))
+    if result["failures"]:
+        for fail in result["failures"]:
+            print(f"perf_gate: FAIL {fail}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

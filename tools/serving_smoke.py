@@ -12,7 +12,14 @@ histograms land.
 
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
                                   [--lockguard] [--prefix-workload]
-                                  [--trace-out trace.json]
+                                  [--trace-out trace.json] [--slo]
+
+``--slo`` switches to the SLO-watchdog leg: the Zipf workload is served
+while a ``TimeSeriesStore`` samples the registry and an ``SLOEvaluator``
+computes multi-window burn rates for ``default_serving_objectives``
+(smoke-sized windows via ``--window``, default 2 s).  The run FAILS
+unless at least one objective accrues a full window with a computed
+burn rate; the JSON line carries every ``slo.burn_rate.*`` gauge.
 
 ``--lockguard`` runs the whole smoke with instrumented threading locks
 (analysis/lockguard.py): lock-order inversions and Eraser-style unguarded
@@ -716,6 +723,137 @@ def run_replicas(requests: int = 48, threads: int = 8, seed: int = 0,
     return result
 
 
+def run_slo(requests: int = 48, threads: int = 4, seed: int = 0,
+            window_s: float = 2.0, ts_interval_s: float = 0.1) -> dict:
+    """The ``--slo`` leg: the Zipf multi-tenant workload served while a
+    :class:`TimeSeriesStore` samples the registry and an
+    :class:`SLOEvaluator` watches ``default_serving_objectives`` over
+    smoke-sized windows (``window_s`` and ``2*window_s`` instead of
+    30/120 s).  The run holds the sampler alive until the short window
+    is fully covered and FAILS unless at least one objective reaches a
+    full window with a computed burn rate — the live end-to-end proof
+    that sampling, windowing, and burn math connect.  Burn rates land
+    in the JSON line; with ``DL4J_TPU_TS_DIR`` set the samples also
+    land as JSONL for ``metrics_dump.py --timeline``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import (METRICS, SLOEvaluator,
+                                                  TimeSeriesStore,
+                                                  default_serving_objectives)
+    from deeplearning4j_tpu.serving import (InferenceEngine, ModelServer,
+                                            ServingClient, ServingConfig,
+                                            ServingError)
+
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+
+    rng = random.Random(seed)
+    n_tenants = 6
+    sys_prompts = [[rng.randrange(cfg.vocab_size)
+                    for _ in range(8)] for _ in range(n_tenants)]
+    zipf_w = [1.0 / (r + 1) ** 1.5 for r in range(n_tenants)]
+    plans = []
+    for _ in range(requests):
+        tenant = rng.choices(range(n_tenants), weights=zipf_w)[0]
+        user = [rng.randrange(cfg.vocab_size)
+                for _ in range(rng.randint(1, 5))]
+        plans.append(dict(prompt=sys_prompts[tenant] + user,
+                          max_new_tokens=rng.randint(1, 8),
+                          temperature=rng.choice([0.0, 0.7]),
+                          seed=rng.randrange(1 << 20)))
+
+    windows = (window_s, 2.0 * window_s)
+    store = TimeSeriesStore(interval_s=ts_interval_s)
+    evaluator = SLOEvaluator(default_serving_objectives(windows=windows),
+                             store, breach_cooldown_s=windows[-1])
+
+    failures: list[str] = []
+    statuses: list[int] = []
+    lock = threading.Lock()
+    t0 = _time.time()
+    store.start()
+    try:
+        engine = InferenceEngine(model, params=params,
+                                 cfg=ServingConfig(slots=4, resolve_every=4))
+        with engine, ModelServer(engine=engine) as server:
+            client = ServingClient(port=server.port)
+
+            def worker(mine):
+                for plan in mine:
+                    try:
+                        client.generate(**plan)
+                        with lock:
+                            statuses.append(200)
+                    except ServingError as e:
+                        with lock:
+                            statuses.append(e.status)
+                            failures.append(str(e))
+
+            ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            # hold the sampler until the short window is fully covered —
+            # a series only exists once its first request lands (after
+            # jit compile), so anchor the hold to the workload's end, and
+            # the registry keeps serving the last percentiles meanwhile
+            deadline = _time.time() + windows[0] * 1.1
+            while _time.time() < deadline:
+                _time.sleep(ts_interval_s)
+    finally:
+        store.stop()
+
+    status = evaluator.status()
+    full_computed = sorted(
+        name for name, burns in status["objectives"].items()
+        if any(b["full"] and b["burn"] is not None for b in burns))
+    gauges = METRICS.snapshot()["gauges"]
+    burn_rates = {k[len("slo.burn_rate."):]: v for k, v in gauges.items()
+                  if k.startswith("slo.burn_rate.")}
+    timers = METRICS.snapshot()["timers"]
+    ttft = timers.get("serving.ttft")
+
+    result = {
+        "workload": "slo",
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "windows_s": list(windows),
+        "completed": statuses.count(200),
+        "rejected": len(statuses) - statuses.count(200),
+        "samples": store.stats()["samples"],
+        "evaluations": status["evaluations"],
+        "burn_rates": burn_rates,
+        "full_window_objectives": full_computed,
+        "breaches": status["breaches"],
+        "ttft_s": ({"p50": ttft["p50_s"], "p99": ttft["p99_s"],
+                    "count": ttft["count"]} if ttft else None),
+        "failures": failures[:5],
+    }
+    assert not failures, failures[:5]
+    assert statuses.count(200) == requests
+    assert status["evaluations"] > 0, "SLO evaluator never ran"
+    assert full_computed, (
+        "no objective reached a full window with a computed burn rate "
+        f"(windows {windows}, {store.stats()['samples']} samples)")
+    assert burn_rates, "no slo.burn_rate.* gauges published"
+    return result
+
+
 def _scrape_counters(prom_text: str, names: tuple[str, ...]) -> dict:
     """Counter samples (``name_total value``) from a Prometheus page."""
     return _scrape_gauges(prom_text, names)
@@ -734,6 +872,11 @@ def main(argv: list[str]) -> int:
                            lockguard="--lockguard" in argv,
                            trace_out=arg("--trace-out", None, str),
                            strict_scaling="--strict-scaling" in argv)
+    elif "--slo" in argv:
+        out = run_slo(requests=arg("--requests", 48),
+                      threads=arg("--threads", 4),
+                      seed=arg("--seed", 0),
+                      window_s=arg("--window", 2.0, float))
     elif "--prefix-workload" in argv:
         out = run_prefix(requests=arg("--requests", 32),
                          threads=arg("--threads", 4),

@@ -32,6 +32,13 @@ TTFT here is time from submission to the end of prefill — the first
 token exists when prefill's last dispatch resolves.  Requests missing a
 ``serving.request`` root (still in flight at export time) are skipped.
 
+Each row is also annotated against the serving TTFT SLO (the same
+objective ``observability.slo.default_serving_objectives`` watches live,
+0.5 s p99 by default — override with ``--slo-ttft-ms``): requests over
+the objective show ``MISS`` in the ``slo`` column, and the summary line
+compares the miss fraction to the error budget (``--slo-budget``, 5%
+default) — the offline twin of the burn-rate gauges.
+
 Exits nonzero when no input file yields any events.
 """
 
@@ -132,7 +139,8 @@ def request_breakdowns(events: list[dict]) -> list[dict]:
     return rows
 
 
-def render(rows: list[dict], limit: int) -> str:
+def render(rows: list[dict], limit: int, slo_ttft_ms: float = 500.0,
+           slo_budget: float = 0.05) -> str:
     if not rows:
         return "no completed serving requests in the trace"
     shown = rows[-limit:] if limit else rows
@@ -140,12 +148,18 @@ def render(rows: list[dict], limit: int) -> str:
     def ms(v):
         return "-" if v is None else f"{v:.2f}"
 
+    def slo(r):
+        if r["ttft_ms"] is None:
+            return "-"
+        return "MISS" if r["ttft_ms"] > slo_ttft_ms else "ok"
+
     headers = ("trace_id", "queue", "route", "hops", "prefill", "decode",
-               "segs", "emit", "ttft", "total", "tokens")
+               "segs", "emit", "ttft", "slo", "total", "tokens")
     cells = [(r["trace_id"][:12], ms(r["queue_wait_ms"]), ms(r["route_ms"]),
               str(r["route_hops"] or "-"), ms(r["prefill_ms"]),
               ms(r["decode_ms"]), str(r["decode_segments"]), ms(r["emit_ms"]),
-              ms(r["ttft_ms"]), ms(r["total_ms"]), str(r["tokens"] or "-"))
+              ms(r["ttft_ms"]), slo(r), ms(r["total_ms"]),
+              str(r["tokens"] or "-"))
              for r in shown]
     widths = [max(len(h), *(len(c[i]) for c in cells))
               for i, h in enumerate(headers)]
@@ -161,6 +175,13 @@ def render(rows: list[dict], limit: int) -> str:
             f"TTFT p50={ttfts[len(ttfts) // 2]:.2f}ms "
             f"p99={ttfts[min(len(ttfts) - 1, (99 * len(ttfts)) // 100)]:.2f}ms "
             f"over {len(ttfts)} requests")
+        misses = sum(1 for t in ttfts if t > slo_ttft_ms)
+        frac = misses / len(ttfts)
+        verdict = ("BREACH" if frac > slo_budget else "within budget")
+        lines.append(
+            f"SLO serving_ttft (objective {slo_ttft_ms:.0f}ms): "
+            f"{misses}/{len(ttfts)} over ({frac:.1%} vs "
+            f"{slo_budget:.0%} budget) — {verdict}")
     return "\n".join(lines)
 
 
@@ -171,6 +192,10 @@ def main(argv=None) -> int:
     ap.add_argument("--out", help="write the merged Chrome trace here")
     ap.add_argument("--limit", type=int, default=20,
                     help="max requests to print (0 = all)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0,
+                    help="TTFT objective for the slo column (ms)")
+    ap.add_argument("--slo-budget", type=float, default=0.05,
+                    help="error budget: tolerated fraction over objective")
     args = ap.parse_args(argv)
 
     merged = merge(args.traces)
@@ -184,7 +209,8 @@ def main(argv=None) -> int:
         Path(args.out).write_text(json.dumps(merged))
         print(f"merged {len(merged['traceEvents'])} events from "
               f"{len(args.traces)} file(s) -> {args.out}")
-    print(render(request_breakdowns(merged["traceEvents"]), args.limit))
+    print(render(request_breakdowns(merged["traceEvents"]), args.limit,
+                 slo_ttft_ms=args.slo_ttft_ms, slo_budget=args.slo_budget))
     return 0
 
 
