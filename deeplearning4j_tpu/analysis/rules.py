@@ -1,4 +1,4 @@
-"""The graftlint rule set — eighteen hazard classes from this repo's history.
+"""The graftlint rule set — nineteen hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -52,6 +52,10 @@
 |       | observe_time/time` that is missing from the documented metrics   |
 |       | tables (README.md / DESIGN.md) — undocumented names drift and    |
 |       | dashboards silently scrape nothing                               |
+| OL01  | non-durable file rewrite on the online-loop / checkpoint publish |
+|       | path: `open("w")`/`write_text`/`write_bytes` in `online/` or     |
+|       | `parallel/checkpoint.py` outside the unique-tempfile + fsync +   |
+|       | `os.replace` idiom — a crash mid-write publishes a torn file     |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -1486,3 +1490,120 @@ class UndocumentedMetricNameRule(Rule):
                 "names drift silently; add a "
                 "`| `name` | kind | description |` row (wildcard "
                 "placeholders allowed) or silence with a reason")
+
+
+@register
+class OnlineDurableWriteRule(Rule):
+    """OL01 — non-durable rewrite on the online-loop / publish path.
+
+    The online learning loop's durability story (DESIGN.md §23) has
+    exactly two sanctioned write shapes: *append-only fsync'd logs* (the
+    capture store — ``open(..., "a")`` plus ``os.fsync``, where a crash
+    costs at most the torn tail replay already tolerates) and
+    *unique-tempfile + fsync + atomic ``os.replace``* for anything
+    rewritten in place (checkpoint payloads, manifests, poison/repair
+    tooling).  A bare ``open(path, "w")`` / ``write_text`` /
+    ``write_bytes`` on these paths is a torn-file publisher: a crash (or
+    injected ``corrupt_file``) mid-write leaves a half-written file at
+    the FINAL name, where a concurrent reader — the serving reload, the
+    replay, ``latest_valid_step()`` — picks it up as truth.
+
+    Fires on truncating opens (mode containing ``w`` or ``x``, incl.
+    ``os.fdopen``) and ``write_text``/``write_bytes`` calls in modules
+    under ``online/`` or in ``parallel/checkpoint.py``, unless the
+    enclosing function visibly carries the idiom: a call to
+    ``os.replace`` AND durability evidence (``os.fsync``, an
+    ``*fsync*``-named helper, or a ``tempfile.mkstemp``/``mkdtemp``/
+    ``NamedTemporaryFile`` unique target).  Append-mode opens are exempt
+    (the log-structured contract).
+
+    Blind spots: writers behind helpers in other modules (``np.savez``
+    onto a final path — route it at a tempfile), modes built at runtime,
+    and idiom halves split across functions (keep open→fsync→replace in
+    ONE function so the reviewer — and this rule — can see the whole
+    contract).  Silence a deliberate non-durable write with
+    ``# graftlint: disable=OL01`` plus the reason.
+    """
+
+    id = "OL01"
+    title = "non-durable rewrite on the online/checkpoint publish path"
+
+    _WRITE_ATTRS = {"write_text", "write_bytes"}
+    _TMP_CALLS = {"tempfile.mkstemp", "tempfile.mkdtemp",
+                  "tempfile.NamedTemporaryFile", "mkstemp", "mkdtemp",
+                  "NamedTemporaryFile"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "/online/" not in path and not path.startswith("online/") \
+                and not path.endswith("parallel/checkpoint.py"):
+            return
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = self._rewrite_label(module, node)
+            if label is None:
+                continue
+            fn = self._enclosing_function(node, parents)
+            if fn is not None and self._has_idiom(module, fn):
+                continue
+            yield self.finding(
+                module, node,
+                f"`{label}` rewrites a file on the online/checkpoint "
+                "publish path without the unique-tempfile + fsync + "
+                "`os.replace` idiom — a crash mid-write publishes a torn "
+                "file under the final name; write to a `tempfile` "
+                "sibling, fsync it, then `os.replace` onto the target "
+                "(appends to fsync'd logs are the one exemption)")
+
+    def _rewrite_label(self, module: ModuleInfo, call: ast.Call) -> str | None:
+        """A display label when ``call`` truncates/rewrites a file."""
+        canon = module.canonical(call.func) or dotted_name(call.func) or ""
+        if canon in ("open", "os.fdopen"):
+            mode = None
+            if len(call.args) >= 2:
+                mode = call.args[1]
+            for kw in call.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                    and any(c in mode.value for c in "wx")):
+                return f'{canon}(..., "{mode.value}")'
+            return None
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in self._WRITE_ATTRS):
+            recv = dotted_name(call.func.value) or "<expr>"
+            return f"{recv}.{call.func.attr}"
+        return None
+
+    @staticmethod
+    def _enclosing_function(node: ast.AST, parents) -> ast.AST | None:
+        while node is not None:
+            node = parents.get(id(node))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return node
+        return None
+
+    def _has_idiom(self, module: ModuleInfo, fn: ast.AST) -> bool:
+        """True when ``fn`` visibly replaces atomically AND shows
+        durability evidence (fsync or a unique tempfile target)."""
+        has_replace = False
+        has_durable = False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            canon = module.canonical(sub.func) or dotted_name(sub.func) or ""
+            name = last_segment(canon) or canon
+            if canon == "os.replace" or name == "replace" and \
+                    canon.startswith("os."):
+                has_replace = True
+            if canon == "os.fsync" or "fsync" in name.lower() \
+                    or canon in self._TMP_CALLS:
+                has_durable = True
+            if has_replace and has_durable:
+                return True
+        return False

@@ -1,0 +1,15 @@
+"""Online learning loop: serve → capture → fine-tune → hot-reload
+(DESIGN.md §23).
+
+The one-dataflow-system composition (ROADMAP item 3, the TensorFlow
+story, arXiv:1605.08695): :class:`CaptureStore` persists served traffic
+durably, :class:`OnlineLoop` fine-tunes on the replayed captures through
+the existing supervised training stack, publishes manifest-verified
+checkpoints, hot-reloads them into live serving at generation-consistent
+fences, and auto-rolls-back any canary- or SLO-failing generation.
+"""
+
+from .capture import CaptureStore
+from .loop import OnlineConfig, OnlineLoop, RoundReport
+
+__all__ = ["CaptureStore", "OnlineConfig", "OnlineLoop", "RoundReport"]

@@ -15,6 +15,20 @@ one that verifies — a truncated or bit-flipped checkpoint is detected and
 skipped (``checkpoint.corrupt_detected``), never silently loaded.  The
 ``checkpoint.write`` fault site corrupts the payload *after* checksums are
 recorded, so the whole detection path is testable in-process.
+
+Publish is race-free against concurrent readers (DESIGN.md §23): every
+step dir materializes fully inside a unique temp dir (payloads fsync'd,
+``meta.json`` written LAST) and appears under its ``ckpt_*`` name only
+via one atomic ``os.replace`` — so ``all_steps()``/``latest_valid_step()``
+polled from a serving process can never list a partially-written step.
+Same-step republish and rotation move the old dir ASIDE (atomic rename to
+a non-``ckpt_`` tombstone) before deleting, so a reader that raced the
+listing sees either the complete old dir or the complete new one, never a
+half-deleted tree; ``all_steps()`` additionally ignores any ``ckpt_*``
+entry without a ``meta.json`` (a crashed pre-fix writer's residue).
+``quarantine(step)`` is the online-rollback hook: it atomically renames a
+published-but-bad step out of the ``ckpt_*`` namespace so
+``latest_valid_step()`` stops offering it without destroying the evidence.
 """
 
 from __future__ import annotations
@@ -48,6 +62,22 @@ class CheckpointCorruptError(RuntimeError):
             f"checkpoint step {step} under {directory} failed checksum "
             "verification — refusing to restore corrupt state")
         self.step = step
+
+
+def _fsync_path(path: Path) -> None:
+    """fsync a file (or a directory's entry table) — the durability half
+    of the unique-tempfile + fsync + ``os.replace`` publish idiom.  Best
+    effort on platforms whose filesystems refuse directory fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _flatten_with_paths(tree) -> dict[str, np.ndarray]:
@@ -210,6 +240,8 @@ class CheckpointManager:
             if key is not None:
                 np.save(tmp / "key.npy", np.asarray(jax.random.key_data(key)))
             payloads = sorted(p for p in tmp.iterdir() if p.is_file())
+            for p in payloads:
+                _fsync_path(p)
             meta = {
                 "step": step,
                 "data_cursor": data_cursor,
@@ -228,34 +260,68 @@ class CheckpointManager:
                 "checksums": {p.name: hashlib.sha256(p.read_bytes()).hexdigest()
                               for p in payloads},
             }
+            # meta.json is the publish marker: written LAST, fsync'd, so a
+            # dir carrying it carries every payload its checksums name
             (tmp / "meta.json").write_text(json.dumps(meta, indent=2))
+            _fsync_path(tmp / "meta.json")
+            _fsync_path(tmp)
             # chaos seam: damage the payload AFTER the manifest is written,
             # exactly like a torn write / bad medium under the checksums
             spec = FAULTS.check("checkpoint.write", step)
             if spec is not None:
                 corrupt_file(tmp / "params.npz", spec.kind)
+            # same-step republish: the old dir moves ASIDE via atomic
+            # rename (never an in-place rmtree) — a racing reader sees
+            # the complete old tree, a clean miss (verify fails CLOSED on
+            # the unreadable path and the walk-back retries), or the
+            # complete new tree; never a half-deleted one.  The absent
+            # window is bounded by two renames.
+            trash = self._trash_path()
             if ckpt_dir.exists():
-                shutil.rmtree(ckpt_dir)
+                os.replace(ckpt_dir, trash)
             os.replace(tmp, ckpt_dir)  # atomic publish
+            _fsync_path(self.directory)
+            shutil.rmtree(trash, ignore_errors=True)
         except Exception:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         self._rotate()
         return ckpt_dir
 
+    def _trash_path(self) -> Path:
+        """A unique non-``ckpt_`` empty dir inside the directory — the
+        rename target for dirs on their way out (``os.replace`` of a dir
+        onto an empty dir is atomic on POSIX), invisible to
+        ``all_steps``."""
+        return Path(tempfile.mkdtemp(prefix=".trash-", dir=self.directory))
+
     def _rotate(self):
         ckpts = self.all_steps()
         for step in ckpts[:-self.keep] if self.keep > 0 else []:
-            shutil.rmtree(self.directory / f"ckpt_{step:010d}", ignore_errors=True)
+            # rename-then-delete: mid-rmtree a concurrent lister must not
+            # find a half-deleted ckpt_* dir (meta present, payloads gone)
+            victim = self.directory / f"ckpt_{step:010d}"
+            trash = self._trash_path()
+            try:
+                os.replace(victim, trash)
+            except OSError:
+                continue  # already gone (another writer rotated it)
+            shutil.rmtree(trash, ignore_errors=True)
 
     # ------------------------------------------------------------------ load
     def all_steps(self) -> list[int]:
         steps = []
         for p in self.directory.glob("ckpt_*"):
             try:
-                steps.append(int(p.name.split("_")[1]))
+                step = int(p.name.split("_")[1])
             except (IndexError, ValueError):
                 continue
+            # publish marker: a ckpt_* dir without meta.json is residue
+            # from a crashed writer (or a reader racing one pre-atomic
+            # publish) — never a listable checkpoint
+            if not (p / "meta.json").is_file():
+                continue
+            steps.append(step)
         return sorted(steps)
 
     def latest_step(self) -> int | None:
@@ -291,6 +357,31 @@ class CheckpointManager:
             if self.verify(step):
                 return step
         return None
+
+    # ------------------------------------------------------------ quarantine
+    def quarantine(self, step: int) -> Path:
+        """Atomically retire a published-but-bad step (the online loop's
+        rollback hook, DESIGN.md §23): one rename moves ``ckpt_<step>``
+        to ``bad_<step>`` — outside the ``ckpt_*`` listing namespace, so
+        ``latest_valid_step()`` stops offering it instantly, while the
+        evidence (a checkpoint that VERIFIES but regressed serving) stays
+        on disk for the flight-recorder bundle to point at.  Returns the
+        quarantine path; raises ``FileNotFoundError`` if the step is not
+        published."""
+        if self.read_only:
+            raise RuntimeError(
+                "CheckpointManager opened read-only (serving open path): "
+                "quarantine() is not allowed")
+        src = self.directory / f"ckpt_{step:010d}"
+        dst = self.directory / f"bad_{step:010d}"
+        if not src.is_dir():
+            raise FileNotFoundError(f"no published checkpoint {src}")
+        if dst.exists():
+            shutil.rmtree(dst, ignore_errors=True)
+        os.replace(src, dst)
+        _fsync_path(self.directory)
+        METRICS.increment("checkpoint.quarantined")
+        return dst
 
     def restore(self, params_template, tstate_template=None,
                 step: int | None = None, *, reshard: bool = False,

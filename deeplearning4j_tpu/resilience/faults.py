@@ -70,6 +70,31 @@ site                   effect when armed
                        cross-width ``CheckpointManager.restore`` before any
                        leaf is re-split — a reshard that dies mid-flight is
                        retried by the supervisor like any step fault
+``capture.write``      the capture store's active segment is damaged on
+                       disk AFTER a record's fsync'd append
+                       (``online/capture.py``, via ``FAULTS.check``;
+                       ``kind``: ``truncate`` | ``bitflip``) — a torn tail
+                       or bit-rot the checksummed replay must skip, never
+                       propagate
+``capture.replay``     :class:`CaptureReplayFault` raised at the start of a
+                       capture-store replay (``CaptureStore.replay``) — a
+                       transient read failure; the online loop abandons the
+                       round and retries on the next one
+``online.publish``     the online loop's publish step fails
+                       (``OnlineLoop``): default kinds raise
+                       :class:`TransientStepFault` (round aborted, retried
+                       next round); ``kind="poison"`` instead rewrites the
+                       just-published checkpoint's params WITH recomputed
+                       checksums — a plausible-but-bad model that verifies
+                       clean and must be caught by the canary gate
+``online.reload``      :class:`TransientStepFault` raised before the online
+                       loop hot-reloads a freshly published step into the
+                       serving tier — the round aborts (serving stays on
+                       its current generation) and retries next round
+``online.rollback``    :class:`TransientStepFault` raised inside the online
+                       loop's rollback path — rollback retries in place
+                       until the injected budget (``max_fires``) exhausts;
+                       a rollback is the recovery path and MUST complete
 =====================  =====================================================
 
 Arming:
@@ -116,6 +141,10 @@ class TransientStepFault(InjectedFault):
 
 class DataIteratorFault(InjectedFault):
     """The input pipeline raised mid-stream (retryable)."""
+
+
+class CaptureReplayFault(InjectedFault):
+    """A capture-store replay failed mid-read (retryable next round)."""
 
 
 class WorkerKilled(InjectedFault):
@@ -179,6 +208,10 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "serving.decode": TransientStepFault,
     "router.route": TransientStepFault,
     "checkpoint.reshard": TransientStepFault,
+    "capture.replay": CaptureReplayFault,
+    "online.publish": TransientStepFault,
+    "online.reload": TransientStepFault,
+    "online.rollback": TransientStepFault,
 }
 
 

@@ -91,12 +91,20 @@ class ScoreRequest:
 
 @dataclasses.dataclass
 class Completion:
-    """Terminal result of a generation request."""
+    """Terminal result of a generation request.
+
+    ``generation``/``loaded_step`` stamp the weight generation EVERY
+    token of this completion was decoded under (DESIGN.md §23): the
+    engine defers hot swaps to resolve fences with no request in flight,
+    so a single response can never mix generations — its tokens equal
+    the offline ``Transformer.sample`` of exactly that checkpoint."""
 
     tokens: list[int]
     finish_reason: str              # "eos" | "length"
     latency_s: float = 0.0
     ttft_s: float | None = None     # fence-granular time to first token
+    generation: int = 0             # weight generation (monotonic per swap)
+    loaded_step: int | None = None  # checkpoint step of that generation
 
 
 class PendingResult:

@@ -103,8 +103,9 @@ class ServingClient:
         return self._json("/v1/score", {"inputs": [list(map(float, r))
                                                    for r in inputs]})["outputs"]
 
-    def reload(self) -> int:
-        return self._json("/v1/reload", {})["step"]
+    def reload(self, step: int | None = None) -> int:
+        body = {} if step is None else {"step": step}
+        return self._json("/v1/reload", body)["step"]
 
     def healthz(self, timeout_s: float | None = None) -> dict:
         return self._json("/healthz", timeout_s=timeout_s)

@@ -139,6 +139,11 @@ class _Slot:
     delivered: list = dataclasses.field(default_factory=list)
     admitted_s: float = 0.0
     first_token_s: float | None = None
+    # weight generation this request was admitted (and will fully decode)
+    # under — swaps apply only at fences with every slot free, so the
+    # stamp is exact, not advisory
+    generation: int = 0
+    loaded_step: int | None = None
 
 
 class InferenceEngine:
@@ -221,6 +226,13 @@ class InferenceEngine:
         # what decode actually reads, int8-quantized when opted in
         self._raw_params = params                # guarded-by: self._lock
         self._params = self._maybe_quantize(params)  # guarded-by: self._lock
+        # generation consistency (DESIGN.md §23): reload() STAGES the new
+        # tree; the swap applies only at a fence with every slot free, so
+        # every response decodes start-to-finish under ONE generation.
+        # _generation counts applied swaps; _staged is the parked
+        # (raw, quantized, step) tuple awaiting an all-slots-free fence.
+        self._generation = 0                     # guarded-by: self._lock
+        self._staged: tuple | None = None        # guarded-by: self._lock
         self._state = self._init_state()
         # device-resident chaos flags, built OUTSIDE the hot loop — the
         # decode segment must not upload scalars under hot_loop_guard
@@ -804,9 +816,18 @@ class InferenceEngine:
 
     def _serve_once(self) -> None:
         self._drain_pending_wipe()
+        with self._lock:
+            applied = self._try_apply_staged_locked()
+            staged = self._staged is not None
+        if applied:
+            self._publish_generation_gauges()
         idle = not self._slots
         n_free = len(self._free)
-        if n_free:
+        if n_free and not staged:
+            # admission pauses while a swap is staged: in-flight slots
+            # drain (each bounds its own decode budget), the fence
+            # arrives, and queued requests then decode wholly under the
+            # NEW generation — never a mid-request mix
             batch = self._queue.take(
                 n_free, block_s=self.cfg.idle_wait_s if idle else 0.0)
             if batch:
@@ -844,6 +865,9 @@ class InferenceEngine:
             with self._lock:
                 slot = self._free.pop()
                 params = self._params
+                # generation stamp is atomic with the params capture —
+                # the pair can never disagree (DESIGN.md §23)
+                gen, lstep = self._generation, self._loaded_step
             acquired: list[int] = []
             try:
                 cached_len = 0
@@ -862,6 +886,7 @@ class InferenceEngine:
                         # prefill that extends it
                         with self._lock:
                             params = self._params
+                            gen, lstep = self._generation, self._loaded_step
                             shared, cached_len = self._pool.lookup_prefix(
                                 req.prompt, usable)
                         acquired.extend(shared)
@@ -938,7 +963,8 @@ class InferenceEngine:
                 continue
             with self._lock:
                 self._slots[slot] = _Slot(pending=p,
-                                          admitted_s=time.monotonic())
+                                          admitted_s=time.monotonic(),
+                                          generation=gen, loaded_step=lstep)
                 self._slot_pages[slot] = acquired
                 self._admitted += 1
             METRICS.increment("serving.admitted")
@@ -1108,7 +1134,8 @@ class InferenceEngine:
             tokens=list(sl.delivered), finish_reason=finish,
             latency_s=now - req.submitted_s,
             ttft_s=(sl.first_token_s - req.submitted_s
-                    if sl.first_token_s is not None else None)))
+                    if sl.first_token_s is not None else None),
+            generation=sl.generation, loaded_step=sl.loaded_step))
         if req.trace_id:
             t_done = time.perf_counter()
             trace.record_span(
@@ -1125,42 +1152,81 @@ class InferenceEngine:
                 tokens=len(sl.delivered), finish=finish)
 
     # ------------------------------------------------------------ hot reload
-    def reload(self) -> int:
-        """Atomic hot swap to ``latest_valid_step()`` WITHOUT draining:
-        in-flight segments finish on the params they dispatched with; the
-        next dispatch reads the new tree.  Shapes are fixed by the config,
-        so the swap hits the existing executables — no recompile, no
-        pause.  With ``prefix_cache`` on, every cached chain is dropped
-        atomically with the swap (its K/V was computed under the old
-        weights — a request admitted after the reload must never alias
-        it); pages pinned only by the cache are wiped by the serve
-        thread at its next fence before becoming allocatable again.
-        Returns the loaded step."""
+    def reload(self, step: int | None = None) -> int:
+        """Hot swap to ``latest_valid_step()`` (or an explicit ``step`` —
+        the online loop's rollback targets a specific previous
+        generation) WITHOUT tearing any response: the new tree is
+        restored off-thread and STAGED; the actual swap applies only at
+        a resolve fence with every slot free (requests bound their own
+        decode length, so the fence arrives within one request budget).
+        While a swap is staged, admission pauses — queued requests wait
+        and then decode wholly under the NEW generation; in-flight ones
+        finish wholly under the OLD one, so every completion's
+        ``generation``/``loaded_step`` stamp is exact.  Shapes are fixed
+        by the config, so the swap hits the existing executables — no
+        recompile.  With ``prefix_cache`` on, every cached chain is
+        dropped atomically with the applied swap (its K/V was computed
+        under the old weights); pages pinned only by the cache are wiped
+        by the serve thread at its next fence before becoming
+        allocatable again.  Returns the target step (applied, or staged
+        for the next free fence)."""
         if self._ckpt is None:
             raise RuntimeError("no checkpoint attached — nothing to reload")
-        step = self._ckpt.latest_valid_step()
-        if step is None:
+        target = step if step is not None else self._ckpt.latest_valid_step()
+        if target is None:
             raise FileNotFoundError(
                 f"no verified checkpoint under {self._ckpt.directory}")
-        if step == self._loaded_step:
-            return step
+        with self._lock:
+            if target == self._loaded_step:
+                # already serving it — and cancel any staged swap away
+                # from it (a rollback racing an un-applied bad reload)
+                self._staged = None
+                return target
+            if self._staged is not None and self._staged[2] == target:
+                return target  # same target already parked for the fence
+            template = self._raw_params
         with allow_transfers(), METRICS.time("serving.reload"):
-            restored = self._ckpt.restore(self._raw_params, step=step)
+            restored = self._ckpt.restore(template, step=target)
             new_params = self._maybe_quantize(restored["params"])
         with self._lock:
-            self._raw_params = restored["params"]
-            self._params = new_params
-            if self._pool is not None and self.cfg.prefix_cache:
-                # same critical section as the swap: _admit's lookup
-                # (also under this lock) can never see old-weight
-                # entries next to the new params.  clear_prefix only
-                # QUARANTINES dead pages — reload runs off the serve
-                # thread and must not wipe device state itself
-                self._pending_wipe.extend(self._pool.clear_prefix())
-        self._loaded_step = step
+            self._staged = (restored["params"], new_params, target)
+            applied = self._try_apply_staged_locked()
         METRICS.increment("serving.reloads")
-        METRICS.gauge("serving.loaded_step", step)
-        return step
+        if applied:
+            self._publish_generation_gauges()
+        return target
+
+    def _try_apply_staged_locked(self) -> bool:
+        """Apply a staged swap iff NO request holds a slot (``_free`` at
+        full capacity covers admitted-but-unregistered requests too: a
+        slot pops off ``_free`` under this lock before its prefill ever
+        reads params).  Caller holds ``self._lock``; gauge publication
+        happens outside it (:meth:`_publish_generation_gauges`) to keep
+        the registry lock un-nested."""
+        if self._staged is None:
+            return False
+        if len(self._free) != self.cfg.slots:
+            return False  # in-flight responses keep their generation
+        raw, quantized, target = self._staged
+        self._staged = None
+        self._raw_params = raw
+        self._params = quantized
+        self._loaded_step = target
+        self._generation += 1
+        if self._pool is not None and self.cfg.prefix_cache:
+            # same critical section as the swap: _admit's lookup (also
+            # under this lock) can never see old-weight entries next to
+            # the new params.  clear_prefix only QUARANTINES dead pages —
+            # the serve thread wipes them at its next fence
+            self._pending_wipe.extend(self._pool.clear_prefix())
+        return True
+
+    def _publish_generation_gauges(self) -> None:
+        with self._lock:
+            gen, step = self._generation, self._loaded_step
+        METRICS.gauge("serving.generation", gen)
+        if step is not None:
+            METRICS.gauge("serving.loaded_step", step)
 
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
@@ -1173,6 +1239,8 @@ class InferenceEngine:
                 "admitted": self._admitted,
                 "completed": self._completed,
                 "loaded_step": self._loaded_step,
+                "generation": self._generation,
+                "reload_staged": self._staged is not None,
                 "prefill_buckets": sorted(self._admit_fns),
                 "running": self._thread is not None,
             }

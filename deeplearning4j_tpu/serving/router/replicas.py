@@ -81,7 +81,7 @@ class Replica:
     def metrics_prom(self, timeout_s: float) -> str:
         raise NotImplementedError
 
-    def reload(self) -> int:
+    def reload(self, step: int | None = None) -> int:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -122,8 +122,8 @@ class EngineReplica(Replica):
     def metrics_prom(self, timeout_s: float) -> str:
         return ""  # in-process replicas share the router's own registry
 
-    def reload(self) -> int:
-        return self.engine.reload()
+    def reload(self, step: int | None = None) -> int:
+        return self.engine.reload(step=step)
 
     def close(self) -> None:
         if self._own:
@@ -228,8 +228,8 @@ class ProcessReplica(Replica):
             raise ReplicaUnavailable(
                 f"replica {self.name} unreachable: {e}") from e
 
-    def reload(self) -> int:
-        return self.client.reload()
+    def reload(self, step: int | None = None) -> int:
+        return self.client.reload(step)
 
     def kill(self) -> None:
         """SIGKILL the child (chaos tests): no goodbye, probes just fail."""

@@ -173,9 +173,10 @@ class PrefixRouter:
                 AllReplicasUnavailable("all replicas failed")
 
     # ------------------------------------------------------------ admin
-    def reload(self) -> dict[str, int]:
-        """Hot-reload every ACTIVE replica; returns name -> loaded step."""
-        return {name: self.pool.replica(name).reload()
+    def reload(self, step: int | None = None) -> dict[str, int]:
+        """Hot-reload every ACTIVE replica (to ``step`` when given — the
+        online loop's fan-out and rollback path); name -> loaded step."""
+        return {name: self.pool.replica(name).reload(step)
                 for name in self.pool.active_names()}
 
     def stats(self) -> dict:

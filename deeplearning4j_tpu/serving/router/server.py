@@ -82,7 +82,7 @@ class RouterServer:
                         if self.path == "/v1/generate":
                             return self._json(200, outer._generate(payload))
                         if self.path == "/v1/reload":
-                            return self._json(200, outer._reload())
+                            return self._json(200, outer._reload(payload))
                     return self._json(404, {"error": f"no route {self.path}"})
                 except ServingRejected as e:
                     # 429 spill-exhausted / 503 no live replica / 504
@@ -117,8 +117,10 @@ class RouterServer:
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None)
 
-    def _reload(self) -> dict:
-        return {"steps": self.router.reload()}
+    def _reload(self, p: dict | None = None) -> dict:
+        step = (p or {}).get("step")
+        return {"steps": self.router.reload(
+            step=int(step) if step is not None else None)}
 
     def _health(self) -> dict:
         replicas = self.router.stats()

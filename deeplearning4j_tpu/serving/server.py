@@ -10,7 +10,8 @@ transport, not a new framework:
   "deadline_ms"}`` → ``{"tokens", "finish_reason", "latency_s", "ttft_s"}``
 - ``POST /v1/score``     — batched forward; ``{"inputs": [[...], ...]}``
   → ``{"outputs": [[...], ...]}``
-- ``POST /v1/reload``    — hot swap to ``latest_valid_step()``
+- ``POST /v1/reload``    — hot swap to ``latest_valid_step()`` (or an
+  explicit ``{"step": N}`` — the online loop's rollback path)
 - ``GET  /healthz``      — liveness + engine slot/queue stats
 - ``GET  /metrics``      — JSON registry snapshot
 - ``GET  /metrics.prom`` — Prometheus text exposition (scrape target)
@@ -42,11 +43,16 @@ class ModelServer:
     def __init__(self, engine=None, scorer=None,
                  registry: MetricsRegistry = METRICS,
                  host: str = "127.0.0.1", port: int = 0,
-                 request_timeout_s: float = 60.0):
+                 request_timeout_s: float = 60.0, capture=None):
         self.engine = engine
         self.scorer = scorer
         self.registry = registry
         self.request_timeout_s = request_timeout_s
+        # online-learning tap (DESIGN.md §23): a CaptureStore (or any
+        # object with .append(dict)) receiving every completed
+        # generation — prompt, tokens, optional caller feedback, and the
+        # weight generation the response decoded under
+        self.capture = capture
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -95,7 +101,7 @@ class ModelServer:
                         if self.path == "/v1/score":
                             return self._json(200, outer._score(payload))
                         if self.path == "/v1/reload":
-                            return self._json(200, outer._reload())
+                            return self._json(200, outer._reload(payload))
                     return self._json(404, {"error": f"no route {self.path}"})
                 except ServingRejected as e:
                     # backpressure IS the API: 429 queue-full, 504 deadline
@@ -129,8 +135,21 @@ class ModelServer:
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None,
             timeout=self.request_timeout_s)
+        if self.capture is not None:
+            # after completion only — rejected/expired requests never
+            # reach the store, so replay sees exactly the served traffic
+            self.capture.append({
+                "prompt": list(p["prompt"]), "tokens": comp.tokens,
+                "finish_reason": comp.finish_reason,
+                "feedback": p.get("feedback"),
+                "generation": comp.generation,
+                "loaded_step": comp.loaded_step,
+                "seed": int(p.get("seed", 0)),
+                "temperature": float(p.get("temperature", 0.0))})
         return {"tokens": comp.tokens, "finish_reason": comp.finish_reason,
-                "latency_s": comp.latency_s, "ttft_s": comp.ttft_s}
+                "latency_s": comp.latency_s, "ttft_s": comp.ttft_s,
+                "generation": comp.generation,
+                "loaded_step": comp.loaded_step}
 
     def _score(self, p: dict) -> dict:
         if self.scorer is None:
@@ -143,10 +162,12 @@ class ModelServer:
         ys = self.scorer.score_batch(xs, timeout=self.request_timeout_s)
         return {"outputs": ys.tolist()}
 
-    def _reload(self) -> dict:
+    def _reload(self, p: dict | None = None) -> dict:
         if self.engine is None:
             raise ValueError("no InferenceEngine mounted on this server")
-        return {"step": self.engine.reload()}
+        step = (p or {}).get("step")
+        return {"step": self.engine.reload(
+            step=int(step) if step is not None else None)}
 
     def _health(self) -> dict:
         out = {"ok": True}

@@ -1,0 +1,437 @@
+"""Online learning loop tests (DESIGN.md §23, ISSUE 15).
+
+The deterministic tier-1 subset of what ``tools/chaos_smoke.py --online``
+keeps rolling dice on: capture-store durability under torn tails and
+damaged media, the generation-consistency invariant (every completion's
+tokens match offline sampling under the checkpoint its OWN stamp names,
+even while a hot reload races the decode loop), canary/SLO-gated
+auto-rollback of a poisoned publish with the flight bundle naming the
+offending step, router-replica reload fan-out, the publish /
+``latest_valid_step`` concurrent-writer contract, a fixed-seed chaos
+plan, and the OL01 durable-write lint rule's trigger contract.
+"""
+
+import json
+import textwrap
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import observability
+from deeplearning4j_tpu.analysis import Analyzer, active, all_rules
+from deeplearning4j_tpu.models.transformer import TransformerConfig, TransformerLM
+from deeplearning4j_tpu.observability import FLIGHTREC, METRICS
+from deeplearning4j_tpu.online import CaptureStore, OnlineConfig, OnlineLoop
+from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+from deeplearning4j_tpu.resilience.faults import corrupt_file
+from deeplearning4j_tpu.serving import InferenceEngine, ServingConfig
+from deeplearning4j_tpu.serving.router import (EngineReplica, PrefixRouter,
+                                               RouterConfig)
+
+import random
+
+
+@pytest.fixture(scope="module")
+def olm():
+    """Tiny f32 LM: the loop's contracts are about dataflow and parity,
+    not model quality, so the smallest transformer that decodes wins."""
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                            d_ff=32, max_len=32, dtype=jnp.float32,
+                            remat=False)
+    model = TransformerLM(cfg)
+    return model, model.init(jax.random.key(7))
+
+
+def _expected(model, params, prompt, n, seed, temperature=0.0):
+    return model.sample(params, prompt, n, temperature=temperature,
+                        key=jax.random.key(seed),
+                        kv_cache=True)[len(prompt):]
+
+
+def _traffic(rng, n, vocab=32):
+    """Synthetic captured records: 4 prompt + 5 generated tokens each, so
+    every 2 records fill exactly one (batch=2, seq=8) training block."""
+    return [{"prompt": [rng.randrange(vocab) for _ in range(4)],
+             "tokens": [rng.randrange(vocab) for _ in range(5)]}
+            for _ in range(n)]
+
+
+OCFG = OnlineConfig(batch=2, seq=8)
+
+
+# --------------------------------------------------------------------------- capture store
+
+def test_capture_roundtrip_rotates_segments(tmp_path):
+    observability.enable()
+    store = CaptureStore(tmp_path, segment_bytes=256)
+    recs = _traffic(random.Random(0), 12)
+    for r in recs:
+        store.append(r)
+    assert len(store.segments()) > 1          # rotation actually happened
+    got = store.records()
+    assert [g["prompt"] for g in got] == [r["prompt"] for r in recs]
+    assert [g["tokens"] for g in got] == [r["tokens"] for r in recs]
+    store.close()
+
+
+def test_capture_torn_tail_truncate_keeps_verified_prefix(tmp_path):
+    observability.enable()
+    before = METRICS.snapshot()["counters"].get("capture.corrupt_records", 0)
+    store = CaptureStore(tmp_path, segment_bytes=1 << 20)
+    recs = _traffic(random.Random(1), 8)
+    for r in recs:
+        store.append(r)
+    store.close()
+    # tear the single segment mid-file: the classic crash artifact
+    corrupt_file(store.segments()[0], "truncate")
+    reopened = CaptureStore(tmp_path, segment_bytes=1 << 20)
+    reopened.append({"prompt": [1, 2, 3, 4], "tokens": [5, 6, 7, 8, 9]})
+    got = reopened.records()
+    # every surviving record is bit-exact and in order; the torn range is
+    # skipped, never parsed into garbage — and the post-damage append is
+    # the final record
+    assert 0 < len(got) < 10
+    for g, r in zip(got[:-1], recs):
+        assert g == r
+    assert got[-1]["tokens"] == [5, 6, 7, 8, 9]
+    # the damaged segment was sealed: the new append lives in a fresh one
+    assert len(reopened.segments()) == 2
+    after = METRICS.snapshot()["counters"]["capture.corrupt_records"]
+    assert after > before
+    reopened.close()
+
+
+def test_capture_bitflip_costs_only_covered_records(tmp_path):
+    observability.enable()
+    store = CaptureStore(tmp_path, segment_bytes=1 << 20)
+    recs = _traffic(random.Random(2), 10)
+    for r in recs:
+        store.append(r)
+    store.close()
+    corrupt_file(store.segments()[0], "bitflip")
+    got = CaptureStore(tmp_path).records()
+    # one flipped byte damages at most the record it lands in (it may
+    # fall on a newline and merge two lines: two records, worst case)
+    assert len(got) >= len(recs) - 2
+    assert all(g in recs for g in got)
+
+
+def test_capture_write_chaos_never_loses_the_store(tmp_path):
+    observability.enable()
+    store = CaptureStore(tmp_path, segment_bytes=1 << 20)
+    recs = _traffic(random.Random(3), 8)
+    with inject_faults(FaultSpec("capture.write", at_step=3, kind="bitflip"),
+                       seed=0):
+        for r in recs:
+            store.append(r)      # damage lands mid-stream; appends go on
+    store.close()
+    got = store.records()
+    assert len(got) >= len(recs) - 2
+    counters = METRICS.snapshot()["counters"]
+    assert counters["faults.injected.capture.write"] == 1
+
+
+# --------------------------------------------------------------------------- loop plumbing
+
+def _make_loop(tmp_path, olm, n_records=6, with_engine=False, **cfg_kw):
+    model, params0 = olm
+    store = CaptureStore(tmp_path / "capture")
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=64)
+    for r in _traffic(random.Random(4), n_records):
+        store.append(r)
+    engine = None
+    if with_engine:
+        engine = InferenceEngine(model, params=params0, checkpoint=mgr,
+                                 cfg=ServingConfig(slots=2, idle_wait_s=0.01))
+        engine.start(warmup=False)
+    loop = OnlineLoop(store, mgr, model, params0=params0, engine=engine,
+                      cfg=OnlineConfig(**{**OCFG.__dict__, **cfg_kw}))
+    return loop, store, mgr, engine
+
+
+def test_round_trains_publishes_and_hot_reloads(tmp_path, olm):
+    observability.enable()
+    model, params0 = olm
+    loop, store, mgr, engine = _make_loop(tmp_path, olm, with_engine=True)
+    try:
+        rep = loop.run_once().to_dict()
+        assert rep["status"] == "ok", rep
+        # 6 records x 9 tokens = 3 full (2, 9) blocks -> 3 steps
+        assert rep["trained_to"] == 3 and rep["reloaded"]["engine"] == 3
+        assert rep["generation"] == 1
+        # a second round with no new captures must be a no-op
+        assert loop.run_once().to_dict()["status"] == "no_new_data"
+        # live requests now decode under the published step-3 bytes and
+        # say so in their stamp
+        out = engine.submit(prompt=[5, 9, 13], max_new_tokens=4,
+                            temperature=0.0, seed=11).result(60.0)
+        assert (out.generation, out.loaded_step) == (1, 3)
+        trained = mgr.restore(params0, step=3)["params"]
+        assert out.tokens == _expected(model, trained, [5, 9, 13], 4, 11)
+    finally:
+        engine.stop()
+    # the loop bootstrapped the pre-training params as step 0: rollback's
+    # floor existed before the first fine-tune ever ran
+    assert 0 in mgr.all_steps()
+
+
+@pytest.mark.lockguard
+def test_generation_stamp_parity_under_concurrent_reload(tmp_path, olm):
+    """The generation-consistency invariant under a racing swap: requests
+    in flight while ``reload`` stages a new checkpoint must each complete
+    entirely under ONE generation, and their stamp must name it."""
+    observability.enable()
+    model, params0 = olm
+    params1 = model.init(jax.random.key(23))      # genuinely different weights
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=8)
+    mgr.save(1, params1)
+    engine = InferenceEngine(model, params=params0, checkpoint=mgr,
+                             cfg=ServingConfig(slots=2, idle_wait_s=0.005))
+    engine.start(warmup=False)
+    rng = random.Random(5)
+    reqs = [dict(prompt=[rng.randrange(32) for _ in range(rng.randint(2, 5))],
+                 max_new_tokens=rng.randint(2, 6), temperature=0.0,
+                 seed=rng.randrange(1 << 16)) for _ in range(12)]
+    outs, lock = [], threading.Lock()
+
+    def worker(mine):
+        for r in mine:
+            out = engine.submit(**r).result(60.0)
+            with lock:
+                outs.append((r, out))
+
+    try:
+        ts = [threading.Thread(target=worker, args=(reqs[i::2],))
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        engine.reload(step=1)                     # races the decode loop
+        for t in ts:
+            t.join()
+    finally:
+        engine.stop()
+    by_step = {None: params0, 1: params1}
+    for r, out in outs:
+        exp = _expected(model, by_step[out.loaded_step], r["prompt"],
+                        len(out.tokens), r["seed"])
+        assert out.tokens == exp, (r, out.loaded_step)
+    assert engine.stats()["loaded_step"] == 1     # the swap did land
+
+
+def test_poison_rollback_quarantines_and_dumps_bundle(tmp_path, olm):
+    observability.enable()
+    loop, store, mgr, engine = _make_loop(tmp_path, olm, with_engine=True)
+    old_dump = FLIGHTREC.dump_dir
+    FLIGHTREC.dump_dir = tmp_path / "rec"
+    try:
+        with inject_faults(
+                FaultSpec("online.publish", at_step=1, kind="poison"),
+                FaultSpec("online.rollback", at_step=1), seed=0):
+            rep = loop.run_once().to_dict()
+    finally:
+        FLIGHTREC.dump_dir = old_dump
+        engine.stop()
+    assert rep["status"] == "rolled_back"
+    assert rep["rollback_reason"] == "canary_nonfinite"
+    # step 3 is quarantined under bad_*; the loop re-landed on step 2
+    # (checkpoint_every=1 checkpoints every fine-tune step)
+    assert rep["quarantined"].endswith("bad_0000000003")
+    assert (tmp_path / "ckpt" / "bad_0000000003").is_dir()
+    assert mgr.latest_valid_step() == 2
+    assert engine.stats()["loaded_step"] == 2
+    assert rep["generation"] == 2                 # forward swap + rollback
+    bundles = sorted((tmp_path / "rec").glob("flightrec-online_rollback-*"))
+    assert bundles, "rollback must leave a flight bundle"
+    extra = json.loads(bundles[-1].read_text())["extra"]
+    assert extra["bad_step"] == 3 and extra["restored_step"] == 2
+    assert extra["reason"] == "canary_nonfinite"
+    counters = METRICS.snapshot()["counters"]
+    assert counters["checkpoint.quarantined"] >= 1
+    assert counters["online.rollbacks"] >= 1
+
+
+def test_slo_breach_during_probation_rolls_back(tmp_path, olm):
+    """A healthy canary is not enough: a breach surfacing in the SLO
+    evaluator during the probation window condemns the generation too
+    (the stub implements ``SLOEvaluator.status()``'s documented shape)."""
+
+    class _BreachesAfterSwap:
+        def __init__(self):
+            self.calls = 0
+
+        def status(self):
+            self.calls += 1
+            return {"breaches": 0 if self.calls == 1 else 1}
+
+    observability.enable()
+    loop, store, mgr, _ = _make_loop(tmp_path, olm, probation_s=0.02,
+                                     probation_poll_s=0.005)
+    loop.slo = _BreachesAfterSwap()
+    rep = loop.run_once().to_dict()
+    assert rep["status"] == "rolled_back"
+    assert rep["rollback_reason"] == "slo_breach"
+    assert mgr.latest_valid_step() == 2
+
+
+def test_router_reload_fans_out_to_every_replica(tmp_path, olm):
+    observability.enable()
+    model, params0 = olm
+    params1 = model.init(jax.random.key(29))
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=8)
+    mgr.save(1, params1)
+    engines = [InferenceEngine(model, params=params0, checkpoint=mgr,
+                               cfg=ServingConfig(slots=2, idle_wait_s=0.01))
+               for _ in range(2)]
+    for e in engines:
+        e.start(warmup=False)
+    reps = [EngineReplica(f"r{i}", e, own_engine=True)
+            for i, e in enumerate(engines)]
+    router = PrefixRouter(reps, RouterConfig())
+    try:
+        out = router.reload(step=1)
+        assert out == {"r0": 1, "r1": 1}
+        for e in engines:
+            assert e.stats()["loaded_step"] == 1
+        got = router.generate([3, 1, 4], 4, temperature=0.0, seed=9)
+        assert got["tokens"] == _expected(model, params1, [3, 1, 4], 4, 9)
+    finally:
+        router.close()
+
+
+# --------------------------------------------------------------------------- publish race
+
+@pytest.mark.lockguard
+def test_publish_race_latest_valid_step_never_sees_torn_checkpoint(tmp_path,
+                                                                   olm):
+    """Concurrent-writer regression (ISSUE 15 satellite): writers publish
+    steps while a reader spins on ``latest_valid_step`` + ``restore`` —
+    the meta.json-last publish order means a step is either invisible or
+    fully restorable, never in between."""
+    _, params0 = olm
+    mgr = CheckpointManager(tmp_path / "ckpt", keep=64)
+    errors: list[str] = []
+    stop = threading.Event()
+
+    def writer(steps):
+        try:
+            for s in steps:
+                mgr.save(s, params0)
+        except Exception as e:                     # noqa: BLE001
+            errors.append(f"writer: {e!r}")
+
+    def reader():
+        seen = 0
+        while not stop.is_set():
+            try:
+                s = mgr.latest_valid_step()
+                if s is not None:
+                    assert s >= seen, f"latest_valid_step went back: {s}<{seen}"
+                    seen = s
+                    mgr.restore(params0, step=s)   # must verify, always
+            except Exception as e:                 # noqa: BLE001
+                errors.append(f"reader: {e!r}")
+                return
+    ws = [threading.Thread(target=writer, args=(range(1, 17, 2),)),
+          threading.Thread(target=writer, args=(range(2, 17, 2),))]
+    rd = threading.Thread(target=reader)
+    rd.start()
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    rd.join()
+    assert not errors, errors
+    assert mgr.latest_valid_step() == 16
+    assert set(mgr.all_steps()) == set(range(1, 17))
+
+
+# --------------------------------------------------------------------------- fixed-seed chaos
+
+def test_fixed_seed_chaos_plan_rolls_back_then_heals(tmp_path, olm):
+    """The chaos_smoke --online storyline, deterministically: a step
+    fault inside the fine-tune, a poisoned publish, a failing rollback
+    seam, and an aborted reload — three rounds later serving is on the
+    cleanly republished step."""
+    observability.enable()
+    model, params0 = olm
+    loop, store, mgr, engine = _make_loop(tmp_path, olm, with_engine=True)
+    try:
+        with inject_faults(FaultSpec("train.step", at_step=2),
+                           FaultSpec("online.publish", at_step=1,
+                                     kind="poison"),
+                           FaultSpec("online.rollback", at_step=1),
+                           FaultSpec("online.reload", at_step=2), seed=7):
+            statuses = [loop.run_once().to_dict()["status"] for _ in range(3)]
+        assert statuses == ["rolled_back", "reload_fault", "ok"]
+        counters = METRICS.snapshot()["counters"]
+        for site in ("train.step", "online.publish", "online.rollback",
+                     "online.reload"):
+            assert counters[f"faults.injected.{site}"] == 1, site
+        # the healed generation serves the republished step-3 bytes
+        assert engine.stats()["loaded_step"] == 3
+        out = engine.submit(prompt=[2, 7, 1], max_new_tokens=4,
+                            temperature=0.0, seed=3).result(60.0)
+        healed = mgr.restore(params0, step=3)["params"]
+        assert out.tokens == _expected(model, healed, [2, 7, 1], 4, 3)
+        assert out.loaded_step == 3
+    finally:
+        engine.stop()
+
+
+# --------------------------------------------------------------------------- OL01 lint
+
+def _lint(source, path):
+    analyzer = Analyzer(rules=[all_rules()["OL01"]])
+    findings = analyzer.analyze_source(textwrap.dedent(source), path)
+    assert not analyzer.errors
+    return {f.rule for f in active(findings)}
+
+
+BAD_WRITE = """
+    def publish(path, blob):
+        with open(path, "w") as f:
+            f.write(blob)
+"""
+
+GOOD_WRITE = """
+    import os
+    import tempfile
+
+    def publish(path, blob):
+        fd, tmp = tempfile.mkstemp(dir=".")
+        with os.fdopen(fd, "w") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+"""
+
+
+def test_ol01_flags_bare_rewrite_on_publish_paths():
+    assert _lint(BAD_WRITE, "deeplearning4j_tpu/online/writer.py") == {"OL01"}
+    assert _lint(BAD_WRITE,
+                 "deeplearning4j_tpu/parallel/checkpoint.py") == {"OL01"}
+
+
+def test_ol01_quiet_on_durable_idiom_appends_and_other_paths():
+    assert _lint(GOOD_WRITE, "deeplearning4j_tpu/online/writer.py") == set()
+    append = """
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+    """
+    assert _lint(append, "deeplearning4j_tpu/online/capture.py") == set()
+    # the rule is scoped: the same bare rewrite elsewhere is other rules'
+    # (and reviewers') business
+    assert _lint(BAD_WRITE, "deeplearning4j_tpu/serving/engine.py") == set()
+
+
+def test_ol01_shipping_tree_is_clean():
+    analyzer = Analyzer(rules=[all_rules()["OL01"]], root=".")
+    findings = analyzer.analyze_paths(["deeplearning4j_tpu"])
+    assert not active(findings), [f.location() for f in active(findings)]

@@ -22,6 +22,15 @@ itself failing once via ``checkpoint.reshard``) and asserts training
 finishes on the surviving mesh inside the documented loss window with a
 ``mesh_resize`` flight bundle emitted (DESIGN.md §21).
 
+A fourth leg (``run_online``, replay with ``--online --seed N``) points
+the dice at the online learning loop (DESIGN.md §23): capture damage,
+replay faults, fine-tune step failures, a poisoned publish, an aborted
+reload, and a failing rollback seam — asserting every served response
+still matches offline sampling under its OWN generation stamp, the
+poisoned checkpoint always quarantines and rolls back with a flight
+bundle, the loop republishes and heals, and the faulted fine-tune's
+goodput audit passes.
+
 Every supervised leg also audits the goodput accounting (DESIGN.md §22):
 the run's state timeline must be exhaustive, sum to independently
 measured wall-clock within 1%, and ``goodput.fraction`` must strictly
@@ -450,12 +459,239 @@ def run_elastic(seed: int) -> dict:
     return result
 
 
+def run_online(seed: int) -> dict:
+    """Chaos leg for the online learning loop (DESIGN.md §23): serve real
+    traffic through a capture-hooked ``ModelServer``, then roll the dice
+    across the loop's whole dataflow — ``capture.write`` damages the
+    active segment mid-wave, ``capture.replay`` kills a round at replay,
+    ``train.step`` (and sometimes ``preempt``) fail the fine-tune,
+    ``online.publish kind="poison"`` rewrites the published params with
+    NaNs under *recomputed* checksums, ``online.reload`` aborts a swap,
+    and ``online.rollback`` fails inside the recovery path itself.
+
+    Acceptance, per ISSUE 15: (a) every completed response's tokens match
+    offline ``Transformer.sample`` under the checkpoint named by its OWN
+    ``loaded_step`` stamp — no request ever decodes under a torn or mixed
+    model, before, during, or after the chaos; (b) the faulted fine-tune's
+    goodput timeline passes the shared §22 audit (exhaustive states, wall
+    parity within 1%, fraction strictly below the fault-free reference);
+    (c) the poisoned checkpoint ALWAYS rolls back — quarantined, an
+    ``online_rollback`` flight bundle naming the bad step, serving back on
+    the previous valid generation — and a later round republishes the
+    same step cleanly and reloads it (the loop heals itself)."""
+    import pathlib
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig, TransformerLM, lm_loss_local)
+    from deeplearning4j_tpu.observability import (FLIGHTREC, GoodputTracker,
+                                                  METRICS)
+    from deeplearning4j_tpu.online import CaptureStore, OnlineConfig, OnlineLoop
+    from deeplearning4j_tpu.optimize import transforms as T
+    from deeplearning4j_tpu.parallel import DataParallelTrainer
+    from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.parallel.mesh import local_mesh
+    from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+    from deeplearning4j_tpu.serving import (InferenceEngine, ModelServer,
+                                            ServingClient, ServingConfig)
+
+    rng = random.Random(seed + 3)
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                            d_ff=32, max_len=32, dtype=jnp.float32,
+                            remat=False)
+    model = TransformerLM(cfg)
+    params0 = model.init(jax.random.key(7))
+    root = tempfile.mkdtemp(prefix="online-chaos-")
+    # tiny segments so the damaged-medium fault lands on a rotating store
+    store = CaptureStore(f"{root}/capture", segment_bytes=1024)
+    mgr = CheckpointManager(f"{root}/ckpt", keep=64)
+    # canary_factor=10: the poison is NaN (caught at ANY factor); a tight
+    # regression factor would let fault-shifted replay streams flake the
+    # scripted heal sequence with false-positive rollbacks
+    ocfg = OnlineConfig(batch=2, seq=8, canary_factor=10.0)
+    engine = InferenceEngine(model, params=params0, checkpoint=mgr,
+                             cfg=ServingConfig(slots=2, idle_wait_s=0.01))
+    loop = OnlineLoop(store, mgr, model, params0=params0, engine=engine,
+                      cfg=ocfg)
+
+    # time each supervised fit from outside, exactly as the other legs
+    # wrap sup.fit — the goodput audit compares the tracker's own wall
+    # against this independent clock
+    fit_walls: list[float] = []
+    orig_fit = loop.supervisor.fit
+
+    def timed_fit(*a, **k):
+        t0 = time.monotonic()
+        try:
+            return orig_fit(*a, **k)
+        finally:
+            fit_walls.append(time.monotonic() - t0)
+
+    loop.supervisor.fit = timed_fit
+
+    served: list[dict] = []
+
+    def wave(client, n):
+        for _ in range(n):
+            req = dict(prompt=[rng.randrange(cfg.vocab_size)
+                               for _ in range(rng.randint(2, 6))],
+                       max_new_tokens=rng.randint(2, 8),
+                       temperature=0.0, seed=rng.randrange(1 << 20))
+            served.append({"req": req, "out": client.generate(**req)})
+
+    reports: list[dict] = []
+    goodput = None
+    rec_dir = tempfile.mkdtemp(prefix="online-chaos-rec-")
+    old_dump_dir = FLIGHTREC.dump_dir
+    FLIGHTREC.dump_dir = pathlib.Path(rec_dir)
+    try:
+        with engine, ModelServer(engine=engine, capture=store) as server:
+            client = ServingClient(port=server.port)
+            # warm round, fault-free: captures wave 1, fine-tunes,
+            # publishes, hot-reloads — and compiles every jit path so the
+            # chaos round's goodput measures recovery, not compilation
+            wave(client, 12)
+            rep = loop.run_once().to_dict()
+            reports.append(rep)
+            assert rep["status"] == "ok", \
+                f"seed {seed}: fault-free warm round failed: {rep}"
+            base_step = mgr.latest_valid_step()
+
+            # fault-free goodput reference: the same replayed stream
+            # through the same trainer construction, no checkpointing
+            batches0 = loop._pack(list(store.replay()))
+
+            def loss_fn(p, xb, yb, key=None):
+                return lm_loss_local(p, xb, yb, model.cfg)
+
+            t_ref = DataParallelTrainer(loss_fn, T.sgd_lr(ocfg.learning_rate),
+                                        mesh=local_mesh(1))
+            gp_ref = GoodputTracker()
+            t_ref.fit(t_ref.init_state(params0), batches0, epochs=1,
+                      goodput=gp_ref)
+            ref_goodput = gp_ref.finish()
+
+            plan = [
+                # fails the fine-tune 1-3 steps past the warm checkpoint
+                FaultSpec("train.step",
+                          at_step=base_step + rng.randint(1, 3)),
+                # damages the active capture segment under a wave-2 append
+                FaultSpec("capture.write", at_step=rng.randint(1, 12),
+                          kind=rng.choice(["truncate", "bitflip"])),
+                # the first publish after the warm round is poisoned
+                FaultSpec("online.publish", at_step=1, kind="poison"),
+                # ...and after its rollback, the republish's reload aborts
+                FaultSpec("online.reload", at_step=2),
+                # rollback's own seam fails once inside recovery
+                FaultSpec("online.rollback", at_step=1),
+            ]
+            if rng.random() < 0.5:
+                plan.append(FaultSpec("capture.replay", at_step=1))
+            if rng.random() < 0.5:
+                plan.append(FaultSpec("preempt",
+                                      at_step=base_step + rng.randint(1, 3)))
+            with inject_faults(*plan, seed=seed):
+                wave(client, 16)
+                walls_before = len(fit_walls)
+                for _ in range(8):
+                    rep = loop.run_once().to_dict()
+                    reports.append(rep)
+                    counters = METRICS.snapshot()["counters"]
+                    if (goodput is None and len(fit_walls) > walls_before
+                            and counters.get("faults.injected.train.step")):
+                        # this round ran the fit the step fault hit; audit
+                        # its report before a later round's fit replaces it
+                        goodput = _goodput_check(loop.supervisor, ref_goodput,
+                                                 fit_walls[-1], seed)
+                    if rep["status"] == "ok":
+                        break
+            # post-chaos traffic decodes under the healed generation
+            wave(client, 6)
+        bundles = sorted(p.name for p in
+                         pathlib.Path(rec_dir).glob("*online_rollback*"))
+    finally:
+        FLIGHTREC.dump_dir = old_dump_dir
+    store.close()
+
+    # generation-consistency audit: every response across ALL waves must
+    # match offline sampling under the checkpoint its OWN stamp names
+    restored_cache: dict = {None: params0}
+
+    def params_at(step):
+        if step not in restored_cache:
+            restored_cache[step] = mgr.restore(params0, step=step)["params"]
+        return restored_cache[step]
+
+    parity_failures = []
+    for rec in served:
+        req, out = rec["req"], rec["out"]
+        exp = model.sample(params_at(out.get("loaded_step")), req["prompt"],
+                           len(out["tokens"]), temperature=0.0,
+                           key=jax.random.key(req["seed"]),
+                           kv_cache=True)[len(req["prompt"]):]
+        if out["tokens"] != exp:
+            parity_failures.append(
+                f"step {out.get('loaded_step')} gen {out.get('generation')}: "
+                f"{out['tokens']} != {exp}")
+
+    rolled = [r for r in reports if r["rolled_back"]]
+    counters = METRICS.snapshot()["counters"]
+    result = {
+        "seed": seed,
+        "plan": [f"{s.site}:at={s.at_step},kind={s.kind}" for s in plan],
+        "base_step": base_step,
+        "requests": len(served),
+        "rounds": [r["status"] for r in reports],
+        "generation": loop.generation,
+        "loaded_step": engine.stats()["loaded_step"],
+        "token_parity_at_stamped_generation": not parity_failures,
+        "parity_failures": parity_failures,
+        "rollbacks": [{"reason": r["rollback_reason"],
+                       "quarantined": r["quarantined"]} for r in rolled],
+        "rollback_bundles": bundles,
+        "captured_records": int(counters.get("online.captured_records", 0)),
+        "corrupt_records": int(counters.get("capture.corrupt_records", 0)),
+        "faults_injected": {k: int(v) for k, v in counters.items()
+                            if k.startswith("faults.injected.")},
+        "goodput": goodput,
+    }
+    assert not parity_failures, \
+        f"seed {seed}: stamped-generation parity broke: {parity_failures}"
+    assert rolled and all(r["rollback_reason"] == "canary_nonfinite"
+                          and r["quarantined"] for r in rolled), \
+        f"seed {seed}: poisoned publish did not roll back: {reports}"
+    assert bundles, f"seed {seed}: rollback emitted no flight bundle"
+    assert reports[-1]["status"] == "ok", \
+        f"seed {seed}: loop never healed after the chaos: {reports}"
+    assert engine.stats()["loaded_step"] == \
+        reports[-1]["reloaded"].get("engine"), \
+        f"seed {seed}: engine not on the healed generation: {reports[-1]}"
+    assert goodput is not None, \
+        f"seed {seed}: train.step never hit a fine-tune round: {reports}"
+    assert result["faults_injected"].get("faults.injected.online.publish"), \
+        result
+    return result
+
+
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
     if "--elastic" in argv:
         # replay a single failing elastic draw
         result = run_elastic(seed if seed is not None
                              else random.SystemRandom().randrange(2 ** 31))
+        print(json.dumps(result))
+        return 0
+    if "--online" in argv:
+        # replay a single failing online-loop draw
+        result = run_online(seed if seed is not None
+                            else random.SystemRandom().randrange(2 ** 31))
         print(json.dumps(result))
         return 0
     if "--stage" in argv:
@@ -475,6 +711,7 @@ def main(argv: list[str]) -> int:
     result["serving"] = run_serving(base)
     result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
     result["elastic"] = run_elastic(base)
+    result["online"] = run_online(base)
     print(json.dumps(result))
     return 0
 

@@ -225,6 +225,36 @@ def render_elasticity(snap: dict) -> str | None:
     return _rows("elasticity (topology changes)", rows, ("metric", "value"))
 
 
+def render_online(snap: dict) -> str | None:
+    """Online learning loop tier (ISSUE 15): live weight generation,
+    reload/rollback counts, captured-traffic volume, and the last hot
+    reload's wall-clock.  Returns None when the process published no
+    ``online.*`` state (offline-only jobs)."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    rows = []
+    if "online.generation" in gauges:
+        rows.append(("generation", f"{gauges['online.generation']:.0f}"))
+    for name, label in (("online.reloads", "reloads"),
+                        ("online.rollbacks", "rollbacks"),
+                        ("online.rounds", "rounds"),
+                        ("online.captured_records", "captured_records"),
+                        ("capture.corrupt_records", "corrupt_records"),
+                        ("checkpoint.quarantined", "quarantined_ckpts")):
+        if name in counters:
+            rows.append((label, f"{counters[name]:.0f}"))
+    if "capture.bytes" in gauges:
+        rows.append(("capture.bytes", f"{gauges['capture.bytes']:.0f} B"))
+    if "online.reload_seconds" in gauges:
+        rows.append(("reload_seconds", _fmt_s(gauges["online.reload_seconds"])))
+    if "online.canary_loss" in gauges:
+        rows.append(("canary_loss", f"{gauges['online.canary_loss']:.4f}"))
+    if not rows:
+        return None
+    return _rows("online loop (serve → capture → fine-tune → reload)",
+                 rows, ("metric", "value"))
+
+
 def render_utilization(snap: dict) -> str | None:
     """MFU / memory-bandwidth gauges from the analytic cost model
     (``observability.cost``): published by the trainer, the decode loop
@@ -280,7 +310,8 @@ def render_metrics(snap: dict) -> str:
         parts.append(state_mem)
     for section in (render_serving(snap), render_kv_capacity(snap),
                     render_router(snap), render_elasticity(snap),
-                    render_goodput(snap), render_utilization(snap)):
+                    render_online(snap), render_goodput(snap),
+                    render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(

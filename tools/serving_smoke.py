@@ -12,7 +12,15 @@ histograms land.
 
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
                                   [--lockguard] [--prefix-workload]
-                                  [--trace-out trace.json] [--slo]
+                                  [--trace-out trace.json] [--slo] [--online]
+
+``--online`` switches to the online-learning leg (DESIGN.md §23): waves
+of greedy traffic are served through a ``ModelServer`` whose capture
+hook feeds a ``CaptureStore``; between waves an ``OnlineLoop`` round
+replays the captures, fine-tunes, publishes a checkpoint and hot-reloads
+it into the live engine.  The run FAILS unless every response's tokens
+match offline sampling under the checkpoint named by its own
+``loaded_step`` stamp and at least one reload applied.
 
 ``--slo`` switches to the SLO-watchdog leg: the Zipf workload is served
 while a ``TimeSeriesStore`` samples the registry and an ``SLOEvaluator``
@@ -859,10 +867,140 @@ def _scrape_counters(prom_text: str, names: tuple[str, ...]) -> dict:
     return _scrape_gauges(prom_text, names)
 
 
+def run_online(requests: int = 24, threads: int = 3, seed: int = 0,
+               rounds: int = 2) -> dict:
+    """The ``--online`` leg: the full serve → capture → fine-tune →
+    hot-reload dataflow (DESIGN.md §23) over the HTTP surface.  Each
+    round serves a wave of greedy requests through a ``ModelServer``
+    whose capture hook feeds a ``CaptureStore``, then runs one
+    ``OnlineLoop`` round — replay, supervised fine-tune, checkpoint
+    publish, canaried hot reload into the live engine.  The run FAILS
+    unless every completed response's tokens match offline
+    ``Transformer.sample`` under the checkpoint named by its OWN
+    ``loaded_step`` stamp (the generation-consistency invariant: no
+    response ever decodes under a torn or mixed model) and at least one
+    reload applied.  The JSON line carries the online metric tier
+    (``online.generation``/``online.reloads``/``capture.bytes``/…)."""
+    import tempfile
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.online import CaptureStore, OnlineConfig, OnlineLoop
+    from deeplearning4j_tpu.parallel.checkpoint import CheckpointManager
+    from deeplearning4j_tpu.serving import (InferenceEngine, ModelServer,
+                                            ServingClient, ServingConfig,
+                                            ServingError)
+
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                            d_ff=32, max_len=32, dtype=jnp.float32,
+                            remat=False)
+    model = TransformerLM(cfg)
+    params0 = model.init(jax.random.key(7))
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix="online-smoke-")
+    store = CaptureStore(f"{root}/capture", segment_bytes=1 << 14)
+    mgr = CheckpointManager(f"{root}/ckpt", keep=32)
+    failures: list[str] = []
+    served: list[dict] = []
+    lock = threading.Lock()
+    round_reports: list[dict] = []
+    t0 = _time.time()
+
+    engine = InferenceEngine(model, params=params0, checkpoint=mgr,
+                             cfg=ServingConfig(slots=2, idle_wait_s=0.01))
+    loop = OnlineLoop(store, mgr, model, params0=params0, engine=engine,
+                      cfg=OnlineConfig(batch=2, seq=8))
+    with engine, ModelServer(engine=engine, capture=store) as server:
+        client = ServingClient(port=server.port)
+
+        def worker(mine):
+            for plan in mine:
+                try:
+                    out = client.generate(**plan)
+                    with lock:
+                        served.append({"plan": plan, "out": out})
+                except ServingError as e:
+                    with lock:
+                        failures.append(f"request failed: {e}")
+
+        per_round = max(1, requests // max(1, rounds))
+        for _ in range(rounds):
+            plans = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                                  for _ in range(rng.randint(2, 6))],
+                          max_new_tokens=rng.randint(2, 8),
+                          temperature=0.0, seed=rng.randrange(1 << 20))
+                     for _ in range(per_round)]
+            ts = [threading.Thread(target=worker,
+                                   args=(plans[i::threads],))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            round_reports.append(loop.run_once().to_dict())
+
+    store.close()
+    # generation-consistency audit: every completed response must match
+    # offline sampling under the checkpoint its OWN stamp names
+    restored_cache: dict = {None: params0}
+
+    def params_at(step):
+        if step not in restored_cache:
+            restored_cache[step] = mgr.restore(params0, step=step)["params"]
+        return restored_cache[step]
+
+    for rec in served:
+        plan, out = rec["plan"], rec["out"]
+        exp = model.sample(params_at(out.get("loaded_step")), plan["prompt"],
+                           len(out["tokens"]), temperature=0.0,
+                           key=jax.random.key(plan["seed"]),
+                           kv_cache=True)[len(plan["prompt"]):]
+        if out["tokens"] != exp:
+            failures.append(
+                f"generation-stamp parity: step {out.get('loaded_step')} "
+                f"gen {out.get('generation')}: {out['tokens']} != {exp}")
+    if not any(r["status"] == "ok" for r in round_reports):
+        failures.append(f"no round applied a reload: {round_reports}")
+
+    snap = METRICS.snapshot()
+    gauges, counters = snap.get("gauges", {}), snap.get("counters", {})
+    return {
+        "ok": not failures,
+        "failures": failures,
+        "requests": len(served),
+        "rounds": [r["status"] for r in round_reports],
+        "generations": sorted({r["out"].get("generation") for r in served}),
+        "online.generation": gauges.get("online.generation"),
+        "online.reloads": counters.get("online.reloads", 0),
+        "online.rollbacks": counters.get("online.rollbacks", 0),
+        "online.captured_records": counters.get("online.captured_records", 0),
+        "capture.bytes": gauges.get("capture.bytes"),
+        "online.reload_seconds": gauges.get("online.reload_seconds"),
+        "wall_s": _time.time() - t0,
+    }
+
+
 def main(argv: list[str]) -> int:
     def arg(flag, default, cast=int):
         return cast(argv[argv.index(flag) + 1]) if flag in argv else default
 
+    if "--online" in argv:
+        out = run_online(requests=arg("--requests", 24),
+                         threads=arg("--threads", 3),
+                         seed=arg("--seed", 0),
+                         rounds=arg("--rounds", 2))
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
     if "--replicas" in argv:
         out = run_replicas(requests=arg("--requests", 48),
                            threads=arg("--threads", 8),
