@@ -1,4 +1,4 @@
-"""The graftlint rule set — nineteen hazard classes from this repo's history.
+"""The graftlint rule set — twenty hazard classes from this repo's history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -52,6 +52,10 @@
 |       | observe_time/time` that is missing from the documented metrics   |
 |       | tables (README.md / DESIGN.md) — undocumented names drift and    |
 |       | dashboards silently scrape nothing                               |
+| OB03  | request-derived data (tenant/request/session/user ids, prompt    |
+|       | text) interpolated into a metric name outside the bounded        |
+|       | tenant-label helper — unbounded label cardinality is a memory    |
+|       | leak with a dashboard                                            |
 | OL01  | non-durable file rewrite on the online-loop / checkpoint publish |
 |       | path: `open("w")`/`write_text`/`write_bytes` in `online/` or     |
 |       | `parallel/checkpoint.py` outside the unique-tempfile + fsync +   |
@@ -1490,6 +1494,110 @@ class UndocumentedMetricNameRule(Rule):
                 "names drift silently; add a "
                 "`| `name` | kind | description |` row (wildcard "
                 "placeholders allowed) or silence with a reason")
+
+
+@register
+class UnboundedMetricCardinalityRule(Rule):
+    """OB03 — request-derived data interpolated into a metric name.
+
+    The registry keys counters/gauges/histograms by name forever: a
+    metric name built from a tenant id, request id, session id, or
+    prompt-derived string mints one immortal series per distinct value —
+    unbounded cardinality, i.e. a memory leak the dashboard renders
+    proudly.  The ONE sanctioned path from request-derived strings to
+    metric names is ``observability/fleet.py``'s ``TenantLabels``: it
+    folds everything beyond the tracked top-K into ``__other__``, so the
+    series set stays bounded by construction.  That module is exempt;
+    everywhere else, an f-string or concatenation passed to
+    ``METRICS.increment/gauge/observe_time/observe_many/time`` (or the
+    same mutators on a ``registry``) whose interpolated parts reference
+    a request-derived identifier — a name, attribute, subscript key, or
+    ``.get("...")`` key in the tenant/request/session/user/prompt
+    family — fails here.
+
+    Blind spots: names composed through intermediate variables
+    (``n = f"x.{tenant}"; METRICS.increment(n)``), identifiers renamed
+    before interpolation (``t = req.tenant``... ``f"x.{t}"``), and
+    ``str.join``/``%``/``.format`` composition.  Silence a
+    deliberately-bounded interpolation (e.g. a fixed enum) with
+    ``# graftlint: disable=OB03`` plus the reason.
+    """
+
+    id = "OB03"
+    title = "request-derived data interpolated into a metric name"
+
+    _MUTATORS = UndocumentedMetricNameRule._MUTATORS
+    _RECEIVERS = UndocumentedMetricNameRule._RECEIVERS
+    _REQUEST_DERIVED = frozenset({
+        "tenant", "tenant_id", "tenants", "request_id", "req_id",
+        "trace_id", "prompt", "user", "user_id", "session", "session_id"})
+    _EXEMPT_SUFFIX = "observability/fleet.py"  # the bounded label helper
+
+    @classmethod
+    def _dynamic_identifiers(cls, arg) -> set[str]:
+        """Lower-cased identifiers referenced by the NON-literal parts
+        of an interpolated metric-name expression."""
+        dyn: list[ast.AST] = []
+        if isinstance(arg, ast.JoinedStr):
+            dyn = [v.value for v in arg.values
+                   if isinstance(v, ast.FormattedValue)]
+        elif isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+            stack: list[ast.AST] = [arg]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+                    stack.extend((n.left, n.right))
+                elif not isinstance(n, ast.Constant):
+                    dyn.append(n)
+        out: set[str] = set()
+        for d in dyn:
+            for sub in ast.walk(d):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id.lower())
+                elif isinstance(sub, ast.Attribute):
+                    out.add(sub.attr.lower())
+                elif isinstance(sub, ast.Subscript):
+                    sl = sub.slice
+                    if isinstance(sl, ast.Constant) \
+                            and isinstance(sl.value, str):
+                        out.add(sl.value.lower())
+                elif isinstance(sub, ast.Call):
+                    # payload.get("tenant") — the key names the data
+                    for a in sub.args:
+                        if isinstance(a, ast.Constant) \
+                                and isinstance(a.value, str):
+                            out.add(a.value.lower())
+        return out
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        if module.path.replace("\\", "/").endswith(self._EXEMPT_SUFFIX):
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._MUTATORS):
+                continue
+            recv = dotted_name(node.func.value) or ""
+            if (last_segment(recv) or recv) not in self._RECEIVERS:
+                continue
+            arg = node.args[0] if node.args else None
+            if arg is None:
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        arg = kw.value
+            if arg is None:
+                continue
+            hits = sorted(self._dynamic_identifiers(arg)
+                          & self._REQUEST_DERIVED)
+            if hits:
+                yield self.finding(
+                    module, node,
+                    f"metric name interpolates request-derived data "
+                    f"({', '.join(hits)}) — every distinct value mints an "
+                    "immortal registry series (unbounded cardinality); "
+                    "route per-tenant accounting through "
+                    "`observability.fleet.TenantLabels` (top-K exact, "
+                    "`__other__` fold) instead of building the name here")
 
 
 @register

@@ -20,6 +20,9 @@ The production observability layer (grown from the seed
 - ``SLObjective``/``SLOEvaluator`` — rolling-window objectives with
   multi-window error-budget burn rates; breaches dump flightrec bundles
   and publish ``slo.burn_rate.*`` (``slo``)
+- ``FleetScraper``/``FederatedRegistry`` — metric federation across a
+  replica pool; ``TENANTS`` bounded tenant labels; ``ForecastEvaluator``
+  time-to-breach extrapolation (``fleet``)
 - ``StatusServer`` — ``/healthz`` ``/metrics`` ``/metrics.prom`` ``/status``
 - ``sample_device_memory`` — per-device HBM gauges (no-op gauge on
   backends without memory stats)
@@ -31,6 +34,14 @@ from . import tracing as trace
 from .core import NOOP_SPAN, disable, enable, enabled
 from .cost import COSTS, CostInfo, CostModel
 from .device import sample_device_memory, sample_state_bytes
+from .fleet import (
+    TENANTS,
+    FederatedRegistry,
+    FleetScraper,
+    ForecastEvaluator,
+    TenantLabels,
+    parse_prometheus,
+)
 from .flightrec import FLIGHTREC, FlightRecorder
 from .metrics import (
     DEFAULT_TIME_BUCKETS,
@@ -48,10 +59,12 @@ from .tracing import TRACER, Tracer, profiler_trace, span
 
 __all__ = [
     "COSTS", "CostInfo", "CostModel", "DEFAULT_TIME_BUCKETS", "FLIGHTREC",
-    "FlightRecorder", "GoodputTracker", "Histogram", "METRICS",
+    "FederatedRegistry", "FleetScraper", "FlightRecorder",
+    "ForecastEvaluator", "GoodputTracker", "Histogram", "METRICS",
     "MetricsRegistry", "NOOP_SPAN", "SLOEvaluator", "SLObjective",
-    "StatusServer", "StepTimer", "TRACER", "TimeSeriesStore", "Tracer",
+    "StatusServer", "StepTimer", "TENANTS", "TRACER", "TenantLabels",
+    "TimeSeriesStore", "Tracer",
     "default_serving_objectives", "default_training_objectives",
-    "disable", "enable", "enabled", "profiler_trace",
+    "disable", "enable", "enabled", "parse_prometheus", "profiler_trace",
     "sample_device_memory", "sample_state_bytes", "span", "trace",
 ]

@@ -125,6 +125,10 @@ class SLOEvaluator:
         self.breach_cooldown_s = float(breach_cooldown_s)
         self.evaluations = 0
         self.breaches: list[str] = []          # bundle paths (or "" if inhibited)
+        # First breach instant per objective (sample-clock time) — the
+        # forecast tier's ground truth: a useful forecast published its
+        # warning strictly before the time recorded here.
+        self.breach_times: dict[str, float] = {}
         self.last: dict[str, list[WindowBurn]] = {}
         self._last_breach_t: dict[str, float] = {}
         if attach:
@@ -195,6 +199,7 @@ class SLOEvaluator:
         if last is not None and now - last < self.breach_cooldown_s:
             return
         self._last_breach_t[obj.name] = now
+        self.breach_times.setdefault(obj.name, now)
         self.registry.increment("slo.breaches")
         tail = self.store.series(obj.series)[-BUNDLE_TAIL:]
         path = self.flightrec.dump("slo_breach", extra={

@@ -180,6 +180,29 @@ class TimeSeriesStore:
         lo = now - seconds
         return [(t, v) for t, v in pts if t >= lo]
 
+    def trend(self, name: str, window_s: float,
+              now: float | None = None) -> tuple[float, float, int] | None:
+        """Least-squares line fit over the trailing ``window_s`` of one
+        series: ``(slope_per_second, r_squared, n_samples)``, or ``None``
+        with fewer than two points (or zero time spread).  A perfectly
+        flat series fits its own flat line exactly (slope 0, R² 1) — the
+        forecast tier reads that as "never breaching", not "no data".
+        """
+        pts = self.window(name, window_s, now)
+        n = len(pts)
+        if n < 2:
+            return None
+        mt = sum(t for t, _ in pts) / n
+        mv = sum(v for _, v in pts) / n
+        sxx = sum((t - mt) ** 2 for t, _ in pts)
+        if sxx <= 0.0:
+            return None  # all points at one instant: slope undefined
+        sxy = sum((t - mt) * (v - mv) for t, v in pts)
+        syy = sum((v - mv) ** 2 for _, v in pts)
+        slope = sxy / sxx
+        r2 = (sxy * sxy) / (sxx * syy) if syy > 0.0 else 1.0
+        return slope, r2, n
+
     def stats(self) -> dict[str, Any]:
         with self._lock:
             return {

@@ -145,6 +145,16 @@ class CaptureStore:
             METRICS.increment("capture.corrupt_records")
             return None
 
+    def replay_tenant(self, tenant: str | None) -> Iterator[dict]:
+        """Replay filtered to one tenant's traffic (``None`` matches
+        records served without a tenant).  The server stamps the RAW
+        tenant id on every capture record (the bounded ``__other__``
+        fold applies to metric names only), so per-tenant fine-tuning
+        (ROADMAP: per-tenant LoRA) slices here losslessly."""
+        for rec in self.replay():
+            if rec.get("tenant") == tenant:
+                yield rec
+
     def records(self) -> list[dict]:
         return list(self.replay())
 

@@ -23,7 +23,7 @@ import time
 from collections import deque
 from typing import Any
 
-from ..observability import FLIGHTREC, METRICS
+from ..observability import FLIGHTREC, METRICS, TENANTS
 from ..resilience.faults import FAULTS
 
 
@@ -77,6 +77,10 @@ class GenerateRequest:
     parent_span_id: str = ""        # inbound traceparent's span (if any)
     root_span_id: str = ""          # the serving.request span's own id
     submitted_perf: float = 0.0     # perf_counter twin of submitted_s (spans)
+    # bounded tenant label (stamped by InferenceEngine.submit through
+    # TenantLabels.label — NEVER a raw request string; empty when the
+    # request carries no tenant or observability is off)
+    tenant: str = ""
 
 
 @dataclasses.dataclass
@@ -173,6 +177,9 @@ class RequestQueue:
         with self._cv:
             if len(self._items) >= self.max_depth:
                 METRICS.increment("serving.rejected")
+                # ScoreRequest carries no tenant field; getattr keeps the
+                # score path free of the attribute
+                TENANTS.account("rejected", getattr(request, "tenant", ""))
                 FLIGHTREC.note_429()
                 raise QueueFull(
                     f"request queue full ({self.max_depth} deep) — retry "
@@ -229,9 +236,14 @@ class RequestQueue:
                             f"request {p.request.id} expired after "
                             f"{now - p.request.submitted_s:.3f}s in queue")):
                         METRICS.increment("serving.deadline_dropped")
+                        TENANTS.account("deadline_dropped",
+                                        getattr(p.request, "tenant", ""))
                     continue
                 METRICS.observe_time("serving.queue_wait",
                                      now - p.request.submitted_s)
+                TENANTS.account("queue_wait_s",
+                                getattr(p.request, "tenant", ""),
+                                now - p.request.submitted_s)
                 out.append(p)
             METRICS.gauge("serving.queue.depth", len(self._items))
         return out
@@ -258,6 +270,8 @@ class RequestQueue:
                         f"{now - p.request.submitted_s:.3f}s before "
                         f"admission")):
                     METRICS.increment("serving.deadline_dropped")
+                    TENANTS.account("deadline_dropped",
+                                    getattr(p.request, "tenant", ""))
                 return False
             return True
 

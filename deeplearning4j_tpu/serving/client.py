@@ -90,6 +90,7 @@ class ServingClient:
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None,
                  deadline_ms: float | None = None,
+                 tenant: str | None = None,
                  timeout_s: float | None = None) -> dict:
         body = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "seed": seed}
@@ -97,6 +98,8 @@ class ServingClient:
             body["eos_id"] = eos_id
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
+        if tenant:
+            body["tenant"] = tenant
         return self._json("/v1/generate", body, timeout_s=timeout_s)
 
     def score(self, inputs) -> list:

@@ -67,7 +67,7 @@ from ..models.transformer import (decode_step, decode_step_paged,
                                   init_paged_cache, paged_flat_index,
                                   reset_cache_pages, reset_cache_slots,
                                   scatter_paged_layer)
-from ..observability import COSTS, FLIGHTREC, METRICS, trace
+from ..observability import COSTS, FLIGHTREC, METRICS, TENANTS, trace
 from ..observability.core import enabled as _obs_enabled
 from ..parallel.checkpoint import CheckpointManager
 from ..parallel.compile_cache import setup_compile_cache
@@ -577,10 +577,15 @@ class InferenceEngine:
     # ------------------------------------------------------------ submission
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                seed: int = 0, eos_id: int | None = None,
-               deadline_ms: float | None = None) -> PendingResult:
+               deadline_ms: float | None = None,
+               tenant: str = "") -> PendingResult:
         """Validate + enqueue; returns a handle whose ``result()`` blocks.
         Raises ``ValueError`` on malformed requests (HTTP 400) and
-        :class:`~.batcher.QueueFull` under backpressure (HTTP 429)."""
+        :class:`~.batcher.QueueFull` under backpressure (HTTP 429).
+        ``tenant`` is an opaque caller identity for per-tenant accounting;
+        it is folded ONCE here through the bounded label helper and the
+        folded label rides the request — downstream metric sites never
+        see the raw string (graftlint OB03)."""
         cfg = self.model.cfg
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -598,7 +603,8 @@ class InferenceEngine:
             temperature=float(temperature), seed=int(seed),
             eos_id=eos_id if eos_id is not None else self.cfg.default_eos_id,
             deadline_s=(time.monotonic() + deadline_ms / 1000.0
-                        if deadline_ms else None))
+                        if deadline_ms else None),
+            tenant=TENANTS.label(str(tenant)) if tenant else "")
         if _obs_enabled():
             # trace identity for the whole request: adopt the caller's
             # context (HTTP traceparent installed via trace.bind, or an
@@ -1130,6 +1136,10 @@ class InferenceEngine:
         req = sl.pending.request
         METRICS.increment("serving.completed")
         METRICS.observe_time("serving.request_latency", now - req.submitted_s)
+        if req.tenant:
+            TENANTS.account("prompt_tokens", req.tenant, len(req.prompt))
+            TENANTS.account("generated_tokens", req.tenant,
+                            len(sl.delivered))
         sl.pending._complete(Completion(
             tokens=list(sl.delivered), finish_reason=finish,
             latency_s=now - req.submitted_s,
@@ -1149,7 +1159,8 @@ class InferenceEngine:
                 t_done - req.submitted_perf, trace_id=req.trace_id,
                 parent_id=req.parent_span_id or None,
                 span_id=req.root_span_id, request=req.id,
-                tokens=len(sl.delivered), finish=finish)
+                tokens=len(sl.delivered), finish=finish,
+                tenant=req.tenant or None)
 
     # ------------------------------------------------------------ hot reload
     def reload(self, step: int | None = None) -> int:

@@ -110,6 +110,7 @@ class EngineReplica(Replica):
             seed=int(payload.get("seed", 0)),
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None,
+            tenant=str(payload.get("tenant") or ""),
             timeout=timeout_s)
         return {"tokens": comp.tokens, "finish_reason": comp.finish_reason,
                 "latency_s": comp.latency_s, "ttft_s": comp.ttft_s}
@@ -173,6 +174,14 @@ class ProcessReplica(Replica):
         self.port = self._await_port(port_file, boot_timeout_s)
         self.client = ServingClient(port=self.port,
                                     timeout_s=client_timeout_s)
+        # dedicated no-retry transport for metric scrapes: the default
+        # client retries idempotent GETs once with backoff, so a child
+        # SIGKILL'd mid-scrape would cost TWO socket timeouts plus the
+        # backoff — past the fleet scraper's per-replica budget.  One
+        # attempt bounds a dead scrape to exactly one ``timeout_s``.
+        self._scrape_client = ServingClient(port=self.port,
+                                            timeout_s=client_timeout_s,
+                                            retries=0)
 
     def _await_port(self, port_file: Path, timeout_s: float) -> int:
         """Boot barrier: the child writes its bound port atomically once
@@ -203,6 +212,7 @@ class ProcessReplica(Replica):
                 seed=int(payload.get("seed", 0)),
                 eos_id=payload.get("eos_id"),
                 deadline_ms=payload.get("deadline_ms"),
+                tenant=payload.get("tenant"),
                 timeout_s=timeout_s)
         except OSError as e:
             # connection refused/reset or socket timeout: the child is
@@ -222,8 +232,14 @@ class ProcessReplica(Replica):
                 f"replica {self.name} unreachable: {e}") from e
 
     def metrics_prom(self, timeout_s: float) -> str:
+        # a child that already exited can never answer: short-circuit
+        # before paying any socket timeout (SIGKILL leaves no listener,
+        # but a half-closed accept queue can still absorb a connect)
+        if self.proc.poll() is not None:
+            raise ReplicaUnavailable(
+                f"replica {self.name} dead (rc={self.proc.returncode})")
         try:
-            return self.client.metrics_prom(timeout_s=timeout_s)
+            return self._scrape_client.metrics_prom(timeout_s=timeout_s)
         except OSError as e:
             raise ReplicaUnavailable(
                 f"replica {self.name} unreachable: {e}") from e

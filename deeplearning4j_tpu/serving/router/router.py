@@ -99,10 +99,12 @@ class PrefixRouter:
                  temperature: float = 0.0, seed: int = 0,
                  eos_id: int | None = None,
                  deadline_ms: float | None = None,
+                 tenant: str | None = None,
                  timeout_s: float | None = None) -> dict:
         """Route one generation; returns the replica's completion dict
         plus ``replica`` (who served it) and ``spills`` (how many nodes
-        were tried before it)."""
+        were tried before it).  ``tenant`` rides the payload opaquely —
+        the serving replica folds it into bounded per-tenant metrics."""
         FAULTS.maybe_fire("router.route")
         payload = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
                    "temperature": temperature, "seed": seed}
@@ -110,6 +112,8 @@ class PrefixRouter:
             payload["eos_id"] = eos_id
         if deadline_ms is not None:
             payload["deadline_ms"] = deadline_ms
+        if tenant:
+            payload["tenant"] = str(tenant)
         timeout = timeout_s if timeout_s is not None \
             else self.cfg.request_timeout_s
         key = self.routing_key(prompt)

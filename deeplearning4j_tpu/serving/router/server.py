@@ -115,7 +115,8 @@ class RouterServer:
             temperature=float(p.get("temperature", 0.0)),
             seed=int(p.get("seed", 0)),
             eos_id=int(eos) if eos is not None else None,
-            deadline_ms=float(dl) if dl is not None else None)
+            deadline_ms=float(dl) if dl is not None else None,
+            tenant=str(p.get("tenant") or ""))
 
     def _reload(self, p: dict | None = None) -> dict:
         step = (p or {}).get("step")

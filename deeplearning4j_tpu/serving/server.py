@@ -7,7 +7,9 @@ transport, not a new framework:
 
 - ``POST /v1/generate``  — continuous-batching decode; body
   ``{"prompt": [ids], "max_new_tokens", "temperature", "seed", "eos_id",
-  "deadline_ms"}`` → ``{"tokens", "finish_reason", "latency_s", "ttft_s"}``
+  "deadline_ms", "tenant"}`` → ``{"tokens", "finish_reason", "latency_s",
+  "ttft_s"}`` (``tenant`` is an opaque caller identity: it lands on the
+  capture record raw and on metrics through the bounded label fold)
 - ``POST /v1/score``     — batched forward; ``{"inputs": [[...], ...]}``
   → ``{"outputs": [[...], ...]}``
 - ``POST /v1/reload``    — hot swap to ``latest_valid_step()`` (or an
@@ -128,20 +130,26 @@ class ModelServer:
             raise ValueError("missing required field 'prompt'")
         eos = p.get("eos_id")
         dl = p.get("deadline_ms")
+        tenant = str(p.get("tenant") or "")
         comp = self.engine.generate(
             p["prompt"], int(p.get("max_new_tokens", 16)),
             temperature=float(p.get("temperature", 0.0)),
             seed=int(p.get("seed", 0)),
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None,
+            tenant=tenant,
             timeout=self.request_timeout_s)
         if self.capture is not None:
             # after completion only — rejected/expired requests never
-            # reach the store, so replay sees exactly the served traffic
+            # reach the store, so replay sees exactly the served traffic.
+            # The RAW tenant id rides the record (replay/fine-tune may
+            # filter by tenant); the bounded fold applies to metric
+            # names only.
             self.capture.append({
                 "prompt": list(p["prompt"]), "tokens": comp.tokens,
                 "finish_reason": comp.finish_reason,
                 "feedback": p.get("feedback"),
+                "tenant": tenant or None,
                 "generation": comp.generation,
                 "loaded_step": comp.loaded_step,
                 "seed": int(p.get("seed", 0)),

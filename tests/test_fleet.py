@@ -1,0 +1,652 @@
+"""Fleet observability plane tests (DESIGN.md §24).
+
+Covers the federation parser as the exact inverse of
+``MetricsRegistry.to_prometheus`` (including torn scrape bodies), the
+``FederatedRegistry``/``FleetScraper`` rollup + staleness semantics, the
+bounded ``TenantLabels`` fold, least-squares ``trend`` math, the
+``ForecastEvaluator``'s crossing predictions and its fire-before-breach
+ordering against the SLO evaluator, the SIGKILL'd-child scrape bound on
+``ProcessReplica``, graftlint OB03's cardinality contract, and the
+``metrics_dump``/``trace_report`` fleet renderings.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+import time
+import tracemalloc
+
+import pytest
+
+from deeplearning4j_tpu import observability as obs
+from deeplearning4j_tpu.observability import (
+    FederatedRegistry,
+    FleetScraper,
+    FlightRecorder,
+    ForecastEvaluator,
+    MetricsRegistry,
+    SLObjective,
+    SLOEvaluator,
+    TimeSeriesStore,
+    parse_prometheus,
+)
+from deeplearning4j_tpu.observability.fleet import OTHER_TENANT, TenantLabels
+
+
+# ----------------------------------------------------------- stub fleet
+class StubReplica:
+    """Replica double: ``body`` is a string, a callable returning one, or
+    an exception instance to raise (a dead scrape)."""
+
+    def __init__(self, name, body):
+        self.name = name
+        self.body = body
+
+    def metrics_prom(self, timeout_s):
+        b = self.body() if callable(self.body) else self.body
+        if isinstance(b, Exception):
+            raise b
+        return b
+
+
+class StubPool:
+    """Duck-typed ``ReplicaPool`` surface the scraper needs."""
+
+    def __init__(self, replicas, inactive=()):
+        self._reps = {r.name: r for r in replicas}
+        self.inactive = set(inactive)
+
+    def names(self):
+        return list(self._reps)
+
+    def is_active(self, name):
+        return name not in self.inactive
+
+    def replica(self, name):
+        return self._reps[name]
+
+
+def _replica_body(tokens: float, tps: float) -> str:
+    """One replica's exposition page, rendered by the real formatter."""
+    reg = MetricsRegistry()
+    reg.increment("serving.tokens", tokens)
+    reg.gauge("serving.tokens_per_sec", tps)
+    reg.gauge("serving.queue.depth", 1.0)
+    return reg.to_prometheus()
+
+
+# ------------------------------------------------------------ round trip
+def test_prometheus_round_trip_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.increment("serving.tokens", 42)
+    reg.increment("serving.requests", 7)
+    reg.gauge("serving.queue.depth", 3.5)
+    reg.observe_time("serving.ttft", 0.12)
+    reg.observe_time("serving.ttft", 0.30)
+    parsed = parse_prometheus(reg.to_prometheus())
+    assert parsed["counters"]["serving_tokens"] == 42.0
+    assert parsed["counters"]["serving_requests"] == 7.0
+    assert parsed["gauges"]["serving_queue_depth"] == 3.5
+    hist = parsed["histograms"]["serving_ttft"]
+    assert hist["count"] == 2.0
+    assert hist["sum"] == pytest.approx(0.42)
+    assert hist["buckets"], "bucket rows must round-trip"
+    # cumulative buckets end at the +Inf row carrying the full count
+    les, cums = zip(*hist["buckets"])
+    assert les[-1] == float("inf") and cums[-1] == 2.0
+    assert list(cums) == sorted(cums)
+
+
+def test_parse_tolerates_torn_bodies_and_garbage():
+    reg = MetricsRegistry()
+    reg.increment("serving.tokens", 9)
+    reg.gauge("serving.queue.depth", 2.0)
+    reg.observe_time("serving.ttft", 0.05)
+    full = reg.to_prometheus()
+    whole = parse_prometheus(full)
+    for cut in range(0, len(full), 7):
+        parsed = parse_prometheus(full[:cut])   # must never raise
+        for section in ("counters", "gauges"):
+            for k, v in parsed[section].items():
+                assert whole[section][k] == v, "a torn prefix may only " \
+                    "lose data, never invent or corrupt it"
+    garbage = "##\nnot a line at all {{{\nx_total notafloat\nlone_token\n"
+    assert parse_prometheus(garbage) == {
+        "counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_parse_classifies_bare_samples_by_suffix_convention():
+    # TYPE headers lost to the tear: _total means counter, else gauge
+    parsed = parse_prometheus("serving_tokens_total 3\nserving_qd 2\n")
+    assert parsed["counters"] == {"serving_tokens": 3.0}
+    assert parsed["gauges"] == {"serving_qd": 2.0}
+
+
+# ------------------------------------------------------------ federation
+def test_federated_registry_values_and_local_staleness():
+    fed = FederatedRegistry()
+    fed.update("a", parse_prometheus(_replica_body(10, 5.0)), t=100.0)
+    fed.update("b", parse_prometheus(_replica_body(20, 7.0)), t=100.0)
+    assert fed.replicas() == ["a", "b"]
+    # dotted and prometheus series names both resolve
+    assert fed.value("serving.tokens", "a") == 10.0
+    assert fed.value("serving.tokens_per_sec", "b") == 7.0
+    fed.mark_stale("b")
+    assert fed.stale_replicas() == ["b"]
+    assert fed.values("serving.tokens") == {"a": 10.0, "b": 20.0}
+    assert fed.values("serving.tokens_per_sec",
+                      include_stale=False) == {"a": 5.0}
+    # staleness age is judged on the LOCAL receive clock only
+    assert fed.age_s("a", now=103.0) == pytest.approx(3.0)
+    fed.update("b", parse_prometheus(_replica_body(25, 6.0)))
+    assert fed.stale_replicas() == []   # a good scrape clears the mark
+    fed.forget("b")
+    assert fed.replicas() == ["a"]
+
+
+def test_scraper_rollups_spreads_and_dead_replica_degradation():
+    obs.enable()
+    reg = MetricsRegistry()
+    scraper = FleetScraper(
+        StubPool([StubReplica("r0", _replica_body(10, 5.0)),
+                  StubReplica("r1", _replica_body(20, 7.0)),
+                  StubReplica("r2", OSError("connection refused"))]),
+        registry=reg)
+    assert scraper.scrape_once() == 2
+    snap = reg.snapshot()
+    assert snap["counters"]["fleet.scrapes"] == 1.0
+    assert snap["counters"]["fleet.scrape_errors"] == 1.0
+    assert scraper.fed.stale_replicas() == ["r2"]
+    # counter rollup: sum of replica counters; gauge rollup: live only
+    assert snap["gauges"]["fleet.tokens_total"] == 30.0
+    assert snap["gauges"]["fleet.tokens_per_sec"] == 12.0
+    assert snap["gauges"]["fleet.spread.serving.tokens_per_sec.min"] == 5.0
+    assert snap["gauges"]["fleet.spread.serving.tokens_per_sec.max"] == 7.0
+    assert snap["gauges"]["fleet.replicas"] == 2.0
+    assert snap["gauges"]["fleet.stale_replicas"] == 1.0
+    assert "fleet.scrape" in snap["timers"]
+
+
+def test_scraper_keeps_stale_counters_but_drops_stale_gauges():
+    obs.enable()
+    reg = MetricsRegistry()
+    health = {"r1": _replica_body(20, 7.0)}
+    pool = StubPool([StubReplica("r0", _replica_body(10, 5.0)),
+                     StubReplica("r1", lambda: health["r1"])])
+    scraper = FleetScraper(pool, registry=reg)
+    scraper.scrape_once()
+    assert reg.snapshot()["gauges"]["fleet.tokens_total"] == 30.0
+    # r1 dies AFTER contributing 20 tokens: the tokens stay in the
+    # counter rollup (history doesn't un-happen), its throughput leaves
+    # the gauge rollup (a dead replica serves nothing)
+    health["r1"] = OSError("replica died")
+    scraper.scrape_once()
+    snap = reg.snapshot()
+    assert scraper.fed.stale_replicas() == ["r1"]
+    assert snap["gauges"]["fleet.tokens_total"] == 30.0
+    assert snap["gauges"]["fleet.tokens_per_sec"] == 5.0
+    assert snap["counters"]["fleet.scrape_errors"] == 1.0
+
+
+def test_scraper_skips_quarantined_without_counting_an_error():
+    obs.enable()
+    reg = MetricsRegistry()
+    pool = StubPool([StubReplica("r0", _replica_body(10, 5.0)),
+                     StubReplica("q", _replica_body(99, 9.0))],
+                    inactive={"q"})
+    scraper = FleetScraper(pool, registry=reg)
+    assert scraper.scrape_once() == 1
+    snap = reg.snapshot()
+    assert snap["counters"].get("fleet.scrape_errors", 0.0) == 0.0
+    assert scraper.fed.stale_replicas() == ["q"]
+    assert snap["gauges"]["fleet.tokens_total"] == 10.0
+
+
+def test_scraper_folds_empty_body_replicas_through_local_registry():
+    """An in-process ``EngineReplica`` answers ``""`` — its series live
+    in the scraper's own registry and are folded in exactly once."""
+    obs.enable()
+    reg = MetricsRegistry()
+    reg.increment("serving.tokens", 4)          # the local engine's counter
+    pool = StubPool([StubReplica("local", ""),
+                     StubReplica("r0", _replica_body(10, 5.0))])
+    scraper = FleetScraper(pool, registry=reg)
+    assert scraper.scrape_once() == 1           # only r0 federates
+    snap = reg.snapshot()
+    assert snap["gauges"]["fleet.tokens_total"] == 14.0
+    assert snap["counters"].get("fleet.scrape_errors", 0.0) == 0.0
+
+
+# --------------------------------------------------------------- tenants
+def test_tenant_fold_is_deterministic_and_bounded():
+    obs.enable()
+    reg = MetricsRegistry()
+    tl = TenantLabels(registry=reg, max_tenants=2)
+    assert tl.label("acme") == "acme"
+    assert tl.label("globex") == "globex"
+    assert tl.label("initech") == OTHER_TENANT      # cap hit: folds
+    assert tl.label("umbrella") == OTHER_TENANT
+    assert tl.label("acme") == "acme"               # tracked stays exact
+    assert tl.tracked() == ["acme", "globex"]
+    assert reg.snapshot()["counters"]["fleet.tenant_overflow"] == 2.0
+    # the fold bucket itself passes through without another overflow
+    assert tl.label(OTHER_TENANT) == OTHER_TENANT
+    assert reg.snapshot()["counters"]["fleet.tenant_overflow"] == 2.0
+
+
+def test_tenant_accounting_mints_bounded_counters_only():
+    obs.enable()
+    reg = MetricsRegistry()
+    tl = TenantLabels(registry=reg, max_tenants=1)
+    tl.account("generated_tokens", "acme", 5)
+    tl.account("generated_tokens", "acme", 3)
+    tl.account("generated_tokens", "globex", 7)     # folds
+    tl.account("queue_wait_s", "globex", 0.25)
+    tl.account("rejected", "")                      # no tenant: no-op
+    counters = reg.snapshot()["counters"]
+    assert counters["tenant.acme.generated_tokens"] == 8.0
+    assert counters["tenant.__other__.generated_tokens"] == 7.0
+    assert counters["tenant.__other__.queue_wait_s"] == 0.25
+    assert not any(k.startswith("tenant.globex.") for k in counters), \
+        "an untracked tenant must never mint its own series"
+
+
+# ----------------------------------------------------------------- trend
+def _store_with(points, name="s", t0=100.0, spacing=1.0):
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(registry=reg)
+    for i, v in enumerate(points):
+        reg.gauge(name, v)
+        store.sample_once(t=t0 + i * spacing)
+    return reg, store
+
+
+def test_trend_fits_ramps_flats_and_refuses_short_history():
+    _, store = _store_with([2.0 * i for i in range(10)])
+    slope, r2, n = store.trend("s", 100.0)
+    assert slope == pytest.approx(2.0)
+    assert r2 == pytest.approx(1.0)
+    assert n == 10
+    _, store = _store_with([3.0] * 8)
+    slope, r2, n = store.trend("s", 100.0)
+    assert slope == 0.0 and r2 == 1.0       # flat: certain, not noisy
+    _, store = _store_with([1.0])
+    assert store.trend("s", 100.0) is None
+    assert store.trend("missing", 100.0) is None
+
+
+def test_trend_uses_only_the_trailing_window():
+    # 10 flat samples then 5 rising: an 100 s window sees a kink, a 5 s
+    # window sees the pure ramp
+    _, store = _store_with([0.0] * 10 + [float(i) for i in range(1, 6)])
+    slope_all = store.trend("s", 100.0)[0]
+    slope_tail = store.trend("s", 4.5)[0]
+    assert slope_tail == pytest.approx(1.0)
+    assert 0.0 < slope_all < slope_tail
+
+
+# -------------------------------------------------------------- forecast
+def test_forecast_predicts_upper_crossing_within_one_sample(tmp_path):
+    obs.enable()
+    reg, store = _store_with([float(i) for i in range(9)])   # v = t - 100
+    obj = SLObjective("ramp", "upper", "s", 10.0, windows=(8.0,))
+    fore = ForecastEvaluator([obj], store, registry=reg,
+                             flightrec=FlightRecorder(tmp_path),
+                             horizon_s=5.0, window_s=100.0, attach=False)
+    now = 108.0
+    out = fore.evaluate(store, now=now)
+    # v crosses 10 at t=110; last sample is (108, 8) with slope 1/s
+    assert out["ramp"] == pytest.approx(2.0)
+    assert now + out["ramp"] == pytest.approx(110.0, abs=1.0)
+    assert reg.snapshot()["gauges"][
+        "forecast.time_to_breach.ramp"] == pytest.approx(2.0)
+    # ttb < horizon: one forecast_breach bundle with the fit inside
+    assert len(fore.warnings) == 1
+    bundles = list(tmp_path.glob("flightrec-forecast_breach-*.json"))
+    assert len(bundles) == 1
+    assert reg.snapshot()["counters"]["forecast.breach_warnings"] == 1.0
+
+
+def test_forecast_flat_noisy_and_receding_publish_inf(tmp_path):
+    obs.enable()
+    rec = FlightRecorder(tmp_path)
+    obj = SLObjective("o", "upper", "s", 10.0, windows=(8.0,))
+    # flat well under the objective: no forecast, no warning
+    reg, store = _store_with([3.0] * 8)
+    fore = ForecastEvaluator([obj], store, registry=reg, flightrec=rec,
+                             horizon_s=1e9, window_s=100.0, attach=False)
+    assert fore.evaluate(store, now=107.0)["o"] == float("inf")
+    # receding: moving AWAY from an upper bound
+    reg, store = _store_with([9.0 - i for i in range(8)])
+    fore = ForecastEvaluator([obj], store, registry=reg, flightrec=rec,
+                             horizon_s=1e9, window_s=100.0, attach=False)
+    assert fore.evaluate(store, now=107.0)["o"] == float("inf")
+    # noisy (R² under the gate): an honest "no forecast"
+    reg, store = _store_with([0.0, 9.0, 1.0, 8.0, 0.5, 9.5, 1.5, 7.0])
+    fore = ForecastEvaluator([obj], store, registry=reg, flightrec=rec,
+                             horizon_s=1e9, window_s=100.0, min_r2=0.5,
+                             attach=False)
+    assert fore.evaluate(store, now=107.0)["o"] == float("inf")
+    # short history (< min_samples): same refusal
+    reg, store = _store_with([1.0, 2.0, 3.0])
+    fore = ForecastEvaluator([obj], store, registry=reg, flightrec=rec,
+                             horizon_s=1e9, window_s=100.0, min_samples=4,
+                             attach=False)
+    assert fore.evaluate(store, now=102.0)["o"] == float("inf")
+
+
+def test_forecast_already_at_threshold_is_zero(tmp_path):
+    obs.enable()
+    reg, store = _store_with([8.0, 9.0, 10.0, 11.0])
+    obj = SLObjective("o", "upper", "s", 10.0, windows=(8.0,))
+    fore = ForecastEvaluator([obj], store, registry=reg,
+                             flightrec=FlightRecorder(tmp_path),
+                             horizon_s=5.0, window_s=100.0, attach=False)
+    assert fore.evaluate(store, now=103.0)["o"] == 0.0
+
+
+def test_forecast_warning_lands_strictly_before_slo_breach(tmp_path):
+    """The §24 ordering contract: on a genuine ramp the forecast bundle
+    fires while the SLO evaluator still sees a healthy series, and the
+    first warning instant precedes ``SLOEvaluator.breach_times``."""
+    obs.enable()
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(registry=reg)
+    obj = SLObjective("serving_ttft", "upper", "serving.ttft.p99", 0.5,
+                      budget=0.05, windows=(8.0, 16.0))
+    slo = SLOEvaluator([obj], store, registry=reg,
+                       flightrec=FlightRecorder(tmp_path / "slo"),
+                       breach_cooldown_s=1e9)
+    fore = ForecastEvaluator([obj], store, registry=reg,
+                             flightrec=FlightRecorder(tmp_path / "fc"),
+                             horizon_s=30.0, window_s=8.0, min_samples=4,
+                             breach_cooldown_s=1e9)
+    t = 0.0
+    while t <= 40.0:
+        reg.gauge("serving.ttft.p99", 0.1 + 0.02 * t)   # crosses 0.5 @ t=20
+        store.sample_once(t=t)
+        t += 0.5
+    warn_t = fore._last_warn_t.get("serving_ttft")
+    breach_t = slo.breach_times.get("serving_ttft")
+    assert warn_t is not None, "forecast never warned on a clean ramp"
+    assert breach_t is not None, "the ramp never actually breached"
+    assert warn_t < breach_t, (
+        f"forecast warned at t={warn_t} but the SLO breach landed at "
+        f"t={breach_t} — the leading indicator must lead")
+    assert list((tmp_path / "fc").glob("flightrec-forecast_breach-*.json"))
+
+
+# ------------------------------------------------------------ concurrency
+@pytest.mark.lockguard
+def test_scraper_and_federated_registry_survive_contention():
+    """Mutator threads hammer the source registry while the scraper
+    federates it and readers walk the federated view — instrumented
+    locks, no deadlock, no exception, and the final quiesced scrape is
+    exact."""
+    obs.enable()
+    source = MetricsRegistry()
+    reg = MetricsRegistry()
+    pool = StubPool([StubReplica("r0", source.to_prometheus),
+                     StubReplica("r1", _replica_body(5, 1.0))])
+    scraper = FleetScraper(pool, registry=reg)
+    errors: list[str] = []
+    stop = threading.Event()
+    n_threads, n_iter = 4, 200
+
+    def mutator(i):
+        try:
+            for k in range(n_iter):
+                source.increment("serving.tokens")
+                source.gauge("serving.tokens_per_sec", float(k))
+                source.observe_time("serving.ttft", 0.001 * (k % 5 + 1))
+        except Exception as e:              # pragma: no cover - failure path
+            errors.append(repr(e))
+
+    def reader():
+        try:
+            while not stop.is_set():
+                scraper.fed.values("serving.tokens")
+                scraper.fed.snapshot()
+                scraper.fed.stale_replicas()
+        except Exception as e:              # pragma: no cover - failure path
+            errors.append(repr(e))
+
+    def scrape_loop():
+        try:
+            while not stop.is_set():
+                scraper.scrape_once()
+        except Exception as e:              # pragma: no cover - failure path
+            errors.append(repr(e))
+
+    threads = ([threading.Thread(target=mutator, args=(i,))
+                for i in range(n_threads)]
+               + [threading.Thread(target=reader),
+                  threading.Thread(target=scrape_loop)])
+    for t in threads:
+        t.start()
+    for t in threads[:n_threads]:
+        t.join(30)
+    stop.set()
+    for t in threads[n_threads:]:
+        t.join(30)
+    assert not errors
+    scraper.scrape_once()                   # quiesced: must be exact now
+    assert scraper.fed.value("serving.tokens", "r0") == n_threads * n_iter
+    assert reg.snapshot()["gauges"]["fleet.tokens_total"] == \
+        n_threads * n_iter + 5
+
+
+# --------------------------------------------------------- disabled-free
+def test_disabled_fleet_paths_allocate_nothing():
+    """DL4J_TPU_OBS=0 contract for the whole plane: the label fold, the
+    accounting, a scrape pass, a forecast pass, and a trend query all
+    run allocation-free while observability is off."""
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(registry=reg)
+    tl = TenantLabels(registry=reg)
+    scraper = FleetScraper(StubPool([StubReplica("r0", "x 1\n")]),
+                           registry=reg)
+    fore = ForecastEvaluator(
+        [SLObjective("o", "upper", "s", 1.0)], store, registry=reg,
+        flightrec=FlightRecorder(), attach=False)
+    obs.disable()
+    try:
+        assert tl.label("acme") == ""
+        assert scraper.scrape_once() == 0
+        assert scraper.start() is False
+        assert fore.evaluate(store, now=1.0) == {}
+        assert store.trend("s", 5.0) is None
+        # warm once, then assert the steady state allocates zero bytes
+        tl.account("generated_tokens", "acme", 1.0)
+        tracemalloc.start()
+        base = tracemalloc.get_traced_memory()[0]
+        for _ in range(50):
+            tl.label("acme")
+            tl.account("generated_tokens", "acme", 1.0)
+            scraper.scrape_once()
+            fore.evaluate(store, now=1.0)
+            store.trend("s", 5.0)
+        grown = tracemalloc.get_traced_memory()[0] - base
+        tracemalloc.stop()
+        assert grown == 0, f"disabled fleet paths allocated {grown} bytes"
+        assert reg.snapshot()["counters"] == {}
+    finally:
+        obs.enable()
+
+
+# ------------------------------------------------- process replica scrape
+def test_process_replica_sigkill_scrape_raises_fast(tmp_path):
+    """Satellite regression: a SIGKILL'd child must surface as
+    ``ReplicaUnavailable`` within the scrape timeout — never a hang, and
+    never the retry-doubled cost of the request transport."""
+    from deeplearning4j_tpu.serving.router.replicas import (
+        ProcessReplica, ReplicaUnavailable)
+
+    rep = ProcessReplica(
+        "pk", "deeplearning4j_tpu.serving.router.procserver:tiny_lm_factory",
+        tmp_path, factory_kwargs={"max_len": 32, "slots": 2},
+        env={"JAX_PLATFORMS": "cpu"}, client_timeout_s=5.0)
+    try:
+        body = rep.metrics_prom(timeout_s=5.0)
+        assert isinstance(body, str)
+        parse_prometheus(body)              # live body parses cleanly
+        rep.kill()
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaUnavailable):
+            rep.metrics_prom(timeout_s=2.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0 + 1.0, (
+            f"dead-child scrape took {elapsed:.1f}s — must be bounded by "
+            "one timeout")
+    finally:
+        rep.close()
+
+
+def test_fleet_scraper_absorbs_a_killed_replica(tmp_path):
+    """The scraper-level view of the same death: errors counted, the
+    dead replica stale, the live replica's rollup intact."""
+    obs.enable()
+    reg = MetricsRegistry()
+    pool = StubPool([StubReplica("live", _replica_body(10, 5.0)),
+                     StubReplica("dead", _replica_body(20, 7.0))])
+    scraper = FleetScraper(pool, registry=reg, timeout_s=2.0)
+    scraper.scrape_once()
+    pool.replica("dead").body = OSError("SIGKILL")
+    t0 = time.monotonic()
+    scraper.scrape_once()
+    assert time.monotonic() - t0 < 2.0 * len(pool.names()) + 1.0
+    snap = reg.snapshot()
+    assert snap["counters"]["fleet.scrape_errors"] == 1.0
+    assert scraper.fed.stale_replicas() == ["dead"]
+    assert snap["gauges"]["fleet.tokens_total"] == 30.0   # history kept
+    assert snap["gauges"]["fleet.tokens_per_sec"] == 5.0  # live only
+
+
+# ------------------------------------------------------------------ OB03
+OB03_BAD = """
+    from deeplearning4j_tpu.observability import METRICS
+    def work(registry, tenant, payload, req, user_id):
+        METRICS.increment(f"tenant.{tenant}.tokens")
+        registry.gauge("user." + user_id + ".latency", 1.0)
+        METRICS.increment(f"per.{payload.get('tenant')}.count")
+        METRICS.observe_time(f"req.{req.request_id}", 0.1)
+"""
+
+OB03_GOOD = """
+    from deeplearning4j_tpu.observability import METRICS, TENANTS
+    def work(site, series, device_id, tenant, registry):
+        METRICS.increment(f"faults.injected.{site}")
+        registry.gauge("fleet.spread." + series + ".min", 1.0)
+        METRICS.gauge(f"train.params_bytes.device.{device_id}", 2.0)
+        TENANTS.account("generated_tokens", tenant, 5)
+        METRICS.increment("serving.requests")
+        name = compute_name(tenant)
+        METRICS.increment(name)          # composed elsewhere: blind spot
+"""
+
+
+def _lint(source, path="deeplearning4j_tpu/serving/snippet.py"):
+    from deeplearning4j_tpu.analysis import Analyzer, all_rules
+    analyzer = Analyzer(rules=[all_rules()["OB03"]])
+    findings = analyzer.analyze_source(textwrap.dedent(source), path)
+    assert not analyzer.errors
+    return findings
+
+
+def test_ob03_fires_on_request_derived_metric_names():
+    findings = _lint(OB03_BAD)
+    assert len(findings) == 4
+    assert {f.rule for f in findings} == {"OB03"}
+    assert any("TenantLabels" in f.message for f in findings)
+
+
+def test_ob03_quiet_on_bounded_interpolations_and_the_helper():
+    assert not _lint(OB03_GOOD)
+    # fleet.py IS the bounded helper: the one sanctioned interpolation site
+    assert not _lint(OB03_BAD,
+                     path="deeplearning4j_tpu/observability/fleet.py")
+
+
+def test_ob03_package_tree_is_clean():
+    """Zero-baseline contract: no package code interpolates
+    request-derived data into metric names outside the helper."""
+    import os
+
+    from deeplearning4j_tpu.analysis import Analyzer, active, all_rules
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    analyzer = Analyzer(rules=[all_rules()["OB03"]], root=repo)
+    findings = analyzer.analyze_paths(
+        [os.path.join(repo, "deeplearning4j_tpu")])
+    assert [f for f in active(findings)] == []
+
+
+# ------------------------------------------------------------------ tools
+def test_metrics_dump_renders_fleet_tenants_and_forecast_tables():
+    from tools.metrics_dump import (render_fleet, render_forecast,
+                                    render_tenants)
+
+    snap = {
+        "gauges": {
+            "fleet.replicas": 3.0, "fleet.stale_replicas": 1.0,
+            "fleet.tokens_per_sec": 12.0, "fleet.tokens_total": 137.0,
+            "fleet.spread.serving.tokens_per_sec.min": 5.0,
+            "fleet.spread.serving.tokens_per_sec.med": 5.0,
+            "fleet.spread.serving.tokens_per_sec.max": 7.0,
+            "forecast.time_to_breach.serving_ttft": float("inf"),
+            "forecast.time_to_breach.serving_error_rate": 42.0,
+        },
+        "counters": {
+            "fleet.scrapes": 4.0, "fleet.scrape_errors": 1.0,
+            "fleet.tenant_overflow": 2.0,
+            "tenant.acme.generated_tokens": 10.0,
+            "tenant.acme.prompt_tokens": 4.0,
+            "tenant.zeta.generated_tokens": 1.0,
+            "tenant.__other__.generated_tokens": 2.0,
+            "tenant.__other__.rejected": 3.0,
+            "forecast.breach_warnings": 1.0,
+        },
+    }
+    fleet = render_fleet(snap)
+    assert "tokens_per_sec" in fleet and "scrape_errors" in fleet
+    assert "spread serving.tokens_per_sec" in fleet
+    tenants = render_tenants(snap)
+    lines = tenants.splitlines()
+    acme_i = next(i for i, ln in enumerate(lines) if "acme" in ln)
+    zeta_i = next(i for i, ln in enumerate(lines) if "zeta" in ln)
+    assert acme_i < zeta_i, "tenants must rank by tokens"
+    assert "__other__" in tenants, "the overflow bucket must stay visible"
+    forecast = render_forecast(snap)
+    assert "serving_ttft" in forecast and "inf" in forecast
+    assert "serving_error_rate" in forecast
+    # non-fleet processes render nothing rather than empty tables
+    empty = {"gauges": {"train.mfu": 0.5}, "counters": {"x": 1.0}}
+    assert render_fleet(empty) is None
+    assert render_tenants(empty) is None
+    assert render_forecast(empty) is None
+
+
+def test_trace_report_carries_the_tenant_column():
+    from tools.trace_report import render, request_breakdowns
+
+    def req(tid, tenant, ts):
+        args = {"trace_id": tid, "tokens": 3}
+        if tenant:
+            args["tenant"] = tenant
+        return [
+            {"ph": "X", "name": "serving.request", "ts": ts, "dur": 5000.0,
+             "args": args},
+            {"ph": "X", "name": "serving.queue_wait", "ts": ts, "dur": 50.0,
+             "args": {"trace_id": tid}},
+            {"ph": "X", "name": "serving.prefill", "ts": ts + 100,
+             "dur": 400.0, "args": {"trace_id": tid}},
+        ]
+
+    events = req("a" * 16, "acme", 0.0) + req("b" * 16, None, 10000.0)
+    rows = request_breakdowns(events)
+    assert [r["tenant"] for r in rows] == ["acme", None]
+    out = render(rows, limit=0)
+    assert "tenant" in out.splitlines()[1]
+    assert "acme" in out
+    # untenanted traffic renders "-" rather than "None"
+    assert "None" not in out

@@ -255,6 +255,102 @@ def render_online(snap: dict) -> str | None:
                  rows, ("metric", "value"))
 
 
+def render_fleet(snap: dict) -> str | None:
+    """Fleet federation plane (ISSUE 16): the ``fleet.*`` rollups the
+    scraper publishes — fleet-wide sums plus the per-replica min/med/max
+    spread of each rolled-up series, and the scrape health counters.
+    Returns None when no :class:`FleetScraper` ran in this process."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    if not any(k.startswith("fleet.") for k in gauges) and \
+            not any(k.startswith("fleet.") for k in counters):
+        return None
+    rows = []
+    for name, label in (("fleet.replicas", "replicas"),
+                        ("fleet.stale_replicas", "stale_replicas"),
+                        ("fleet.tokens_per_sec", "tokens_per_sec"),
+                        ("fleet.kv_pages_in_use", "kv_pages_in_use"),
+                        ("fleet.queue_depth", "queue_depth"),
+                        ("fleet.tokens_total", "tokens_total")):
+        if name in gauges:
+            rows.append((label, f"{gauges[name]:.6g}", "", "", ""))
+    # spread rows: fleet.spread.<series>.{min,med,max}
+    spreads: dict[str, dict[str, float]] = {}
+    prefix = "fleet.spread."
+    for k, v in gauges.items():
+        if k.startswith(prefix):
+            base, _, stat = k[len(prefix):].rpartition(".")
+            if stat in ("min", "med", "max"):
+                spreads.setdefault(base, {})[stat] = v
+    for base, s in sorted(spreads.items()):
+        rows.append((f"spread {base}", "",
+                     f"{s.get('min', 0.0):.6g}", f"{s.get('med', 0.0):.6g}",
+                     f"{s.get('max', 0.0):.6g}"))
+    for name, label in (("fleet.scrapes", "scrapes"),
+                        ("fleet.scrape_errors", "scrape_errors"),
+                        ("fleet.tenant_overflow", "tenant_overflow")):
+        if name in counters:
+            rows.append((label, f"{counters[name]:.0f}", "", "", ""))
+    if not rows:
+        return None
+    return _rows("fleet (federated rollups + spread)", rows,
+                 ("metric", "value", "min", "med", "max"))
+
+
+def render_tenants(snap: dict, top_k: int = 10) -> str | None:
+    """Per-tenant accounting (ISSUE 16): the ``tenant.<label>.*``
+    counters fed through the bounded :class:`TenantLabels` fold, ranked
+    by tokens generated; the ``__other__`` overflow bucket renders like
+    any tenant so folded traffic stays visible.  Returns None when no
+    tenant traffic was accounted."""
+    counters = snap.get("counters", {})
+    tenants: dict[str, dict[str, float]] = {}
+    for k, v in counters.items():
+        if not k.startswith("tenant."):
+            continue
+        label, _, field = k[len("tenant."):].rpartition(".")
+        if label:
+            tenants.setdefault(label, {})[field] = v
+    if not tenants:
+        return None
+    ranked = sorted(tenants.items(),
+                    key=lambda kv: -(kv[1].get("generated_tokens", 0.0) +
+                                     kv[1].get("prompt_tokens", 0.0)))
+    rows = []
+    for label, c in ranked[:top_k]:
+        rows.append((label,
+                     f"{c.get('prompt_tokens', 0.0):.0f}",
+                     f"{c.get('generated_tokens', 0.0):.0f}",
+                     _fmt_s(c.get("queue_wait_s", 0.0)),
+                     f"{c.get('rejected', 0.0):.0f}",
+                     f"{c.get('deadline_dropped', 0.0):.0f}"))
+    title = f"tenants (top {min(top_k, len(ranked))} of {len(ranked)} by tokens)"
+    if len(ranked) > top_k:
+        title += f" [+{len(ranked) - top_k} not shown]"
+    return _rows(title, rows,
+                 ("tenant", "prompt_tok", "gen_tok", "queue_wait",
+                  "rejected", "deadline_dropped"))
+
+
+def render_forecast(snap: dict) -> str | None:
+    """Trend forecasts (ISSUE 16): predicted seconds until each SLO
+    objective breaches (``+Inf`` = flat/receding/noisy — no forecast),
+    plus the warning count.  Returns None without a ForecastEvaluator."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    prefix = "forecast.time_to_breach."
+    rows = [(k[len(prefix):],
+             "inf" if v == float("inf") else _fmt_s(v))
+            for k, v in sorted(gauges.items()) if k.startswith(prefix)]
+    if "forecast.breach_warnings" in counters:
+        rows.append(("breach_warnings",
+                     f"{counters['forecast.breach_warnings']:.0f}"))
+    if not rows:
+        return None
+    return _rows("forecast (time to SLO breach)", rows,
+                 ("objective", "time_to_breach"))
+
+
 def render_utilization(snap: dict) -> str | None:
     """MFU / memory-bandwidth gauges from the analytic cost model
     (``observability.cost``): published by the trainer, the decode loop
@@ -309,9 +405,10 @@ def render_metrics(snap: dict) -> str:
     if state_mem is not None:
         parts.append(state_mem)
     for section in (render_serving(snap), render_kv_capacity(snap),
-                    render_router(snap), render_elasticity(snap),
+                    render_router(snap), render_fleet(snap),
+                    render_tenants(snap), render_elasticity(snap),
                     render_online(snap), render_goodput(snap),
-                    render_utilization(snap)):
+                    render_forecast(snap), render_utilization(snap)):
         if section is not None:
             parts.append(section)
     parts.append(_rows(

@@ -60,6 +60,7 @@ SERIES: tuple[tuple[str, tuple[str, ...], str], ...] = (
     ("ttft_p99_s", ("ttft_s.p99", "serving.ttft.p99", "ttft_p99_s"), "lower"),
     ("goodput_fraction",
      ("goodput.fraction", "goodput_fraction"), "higher"),
+    ("fleet_scrape_ms", ("fleet.scrape_ms",), "lower"),
 )
 
 DIRECTIONS = {name: direction for name, _, direction in SERIES}

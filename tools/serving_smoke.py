@@ -41,6 +41,17 @@ FAILS unless every completed request's trace carries the full
 queue_wait -> prefill -> decode -> emit chain under one trace id.  Feed
 the file to ``tools/trace_report.py`` for the per-request TTFT breakdown.
 
+``--fleet`` switches to the fleet-observability leg (DESIGN.md §24): a
+Zipf multi-tenant workload over ``--replicas N`` (default 3) REAL
+process replicas, federated through a ``FleetScraper``.  The run FAILS
+unless the federated token counters equal the sum of every replica's
+own counters equal the client-observed totals EXACTLY (overall and per
+tenant), a mid-run SIGKILL of one replica degrades to
+``fleet.scrape_errors`` + a stale mark for that replica only, and — on
+a synthetic ramp — the ``forecast_breach`` flight bundle lands strictly
+before the ``SLOEvaluator`` records the breach.  The JSON line carries
+``{"fleet": {"scrape_ms": ...}}`` for ``perf_gate.py --record``.
+
 ``--replicas N`` switches to the multi-replica router smoke: the SAME
 Zipf multi-tenant workload is run twice through a ``RouterServer`` —
 once over a single replica, once over N — with the aggregate
@@ -990,6 +1001,227 @@ def run_online(requests: int = 24, threads: int = 3, seed: int = 0,
     }
 
 
+def run_fleet(requests: int = 36, threads: int = 6, seed: int = 0,
+              replicas: int = 3) -> dict:
+    """The ``--fleet`` leg (DESIGN.md §24): a Zipf multi-tenant workload
+    over N REAL process replicas (each with its own registry), federated
+    by a :class:`FleetScraper` over the router's pool.
+
+    Three contracts are asserted live:
+
+    - **Exact federation**: the ``fleet.tokens_total`` rollup equals the
+      sum of every replica's own ``serving.tokens`` counter equals the
+      client-observed token total — token-for-token, no sampling slack.
+    - **Exact tenancy**: every tenant's ``tenant.<t>.generated_tokens``,
+      summed across replicas, equals the tokens the client watched that
+      tenant receive.
+    - **Graceful degradation**: SIGKILLing one replica mid-run costs
+      ``fleet.scrape_errors`` plus a stale mark for THAT replica only —
+      scrapes never hang, other replicas' rollups stay exact, and the
+      killed replica's already-generated tokens stay in the counter
+      rollup (stale counters are history, not noise).
+
+    A synthetic-ramp forecast phase then proves the §24 ordering claim:
+    ``forecast.time_to_breach.serving_ttft`` dumps its
+    ``forecast_breach`` bundle strictly before the ``SLOEvaluator``
+    records the actual breach.  The JSON line carries
+    ``{"fleet": {"scrape_ms": ...}}`` for ``perf_gate.py``.
+    """
+    import tempfile
+    import time as _time
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.observability import (METRICS, FleetScraper,
+                                                  ForecastEvaluator,
+                                                  MetricsRegistry,
+                                                  SLOEvaluator, SLObjective,
+                                                  TENANTS, TimeSeriesStore)
+    from deeplearning4j_tpu.serving import (PrefixRouter, ProcessReplica,
+                                            RouterConfig, RouterServer,
+                                            ServingClient, ServingError)
+
+    observability.enable()
+    METRICS.reset()
+    TENANTS.reset()
+
+    rng = random.Random(seed)
+    vocab, page_size = 64, 4
+    tenants = ["acme", "globex", "initech", "umbrella"]
+    zipf_w = [1.0 / (r + 1) ** 1.5 for r in range(len(tenants))]
+
+    def make_plans(n: int) -> list[dict]:
+        out = []
+        for _ in range(n):
+            t = rng.choices(tenants, weights=zipf_w)[0]
+            out.append(dict(prompt=[rng.randrange(vocab)
+                                    for _ in range(rng.randint(2, 10))],
+                            max_new_tokens=rng.randint(1, 8),
+                            temperature=rng.choice([0.0, 0.7]),
+                            seed=rng.randrange(1 << 20), tenant=t))
+        return out
+
+    failures: list[str] = []
+    observed: list[tuple[str, int]] = []      # (tenant, tokens delivered)
+    lock = threading.Lock()
+    workdir = tempfile.mkdtemp(prefix="fleet-smoke-")
+    reps = [ProcessReplica(
+        f"p{i}", "deeplearning4j_tpu.serving.router.procserver"
+                 ":tiny_lm_factory", workdir,
+        factory_kwargs={"max_len": 32, "slots": 2, "paged": True,
+                        "page_size": page_size, "prefix_cache": True},
+        env={"JAX_PLATFORMS": "cpu"}, client_timeout_s=30.0)
+        for i in range(replicas)]
+    router = PrefixRouter(reps, RouterConfig(
+        page_size=page_size, affinity_pages=2, probe_interval_s=0.2,
+        fail_threshold=2, recover_threshold=2))
+    scraper = FleetScraper(router.pool, interval_s=0.25, timeout_s=5.0)
+
+    def drive(plans):
+        def worker(mine):
+            for plan in mine:
+                try:
+                    out = client.generate(**plan)
+                    with lock:
+                        observed.append((plan["tenant"],
+                                         len(out["tokens"])))
+                except ServingError as e:
+                    with lock:
+                        failures.append(str(e))
+
+        ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    with RouterServer(router) as server:
+        client = ServingClient(port=server.port)
+        scraper.start()
+        drive(make_plans(requests // 2))
+        _time.sleep(0.2)                   # let final evictions account
+        scraper.scrape_once()              # all replicas alive + scraped
+        live_before = dict(
+            scraper.fed.values("serving.tokens", include_stale=True))
+        if len(live_before) != replicas:
+            failures.append(
+                f"expected {replicas} federated replicas before the kill, "
+                f"got {sorted(live_before)}")
+
+        # chaos: SIGKILL one replica; scrapes must fail fast (bounded by
+        # one timeout, here a poll() short-circuit), mark ONLY it stale,
+        # and keep its already-generated tokens in the counter rollup
+        killed = reps[-1].name
+        reps[-1].kill()
+        t_kill = _time.perf_counter()
+        scraper.scrape_once()
+        kill_scrape_s = _time.perf_counter() - t_kill
+
+        drive(make_plans(requests - requests // 2))
+        _time.sleep(0.2)
+        scraper.scrape_once()
+        scraper.stop()
+        snap = METRICS.snapshot()
+
+        # per-replica ground truth: scrape the LIVE replicas directly
+        # (the killed one's truth is its last federated value)
+        per_replica: dict[str, float] = {}
+        for rep in reps:
+            if rep.name == killed:
+                per_replica[rep.name] = live_before.get(killed, 0.0)
+                continue
+            body = rep.metrics_prom(timeout_s=5.0)
+            per_replica[rep.name] = _scrape_counters(
+                body, ("serving_tokens_total",)).get(
+                    "serving_tokens_total", 0.0)
+
+    client_tokens = sum(n for _, n in observed)
+    fed_tokens = scraper.fed.values("serving.tokens", include_stale=True)
+    fed_total = sum(fed_tokens.values())
+    fleet_gauge = snap["gauges"].get("fleet.tokens_total")
+    scrape_errors = snap["counters"].get("fleet.scrape_errors", 0.0)
+    stale = scraper.fed.stale_replicas()
+    client_by_tenant: dict[str, int] = {}
+    for t, n in observed:
+        client_by_tenant[t] = client_by_tenant.get(t, 0) + n
+    fed_by_tenant = {
+        t: sum(scraper.fed.values(f"tenant.{t}.generated_tokens",
+                                  include_stale=True).values())
+        for t in tenants}
+    scrape_timer = snap["timers"].get("fleet.scrape")
+
+    # ---- synthetic-ramp forecast phase: warning strictly before breach
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(registry=reg)
+    obj = SLObjective("serving_ttft", "upper", "serving.ttft.p99", 0.5,
+                      budget=0.05, windows=(8.0, 16.0))
+    slo = SLOEvaluator([obj], store, registry=reg,
+                       breach_cooldown_s=1e9)
+    fore = ForecastEvaluator([obj], store, registry=reg, horizon_s=30.0,
+                             window_s=8.0, min_samples=4,
+                             breach_cooldown_s=1e9)
+    t = 0.0
+    while t <= 40.0:
+        reg.gauge("serving.ttft.p99", 0.1 + 0.02 * t)   # crosses 0.5 @ t=20
+        store.sample_once(t=t)
+        t += 0.5
+    warn_t = fore._last_warn_t.get("serving_ttft")
+    breach_t = slo.breach_times.get("serving_ttft")
+    forecast_led = (warn_t is not None and breach_t is not None
+                    and warn_t < breach_t)
+
+    result = {
+        "workload": "fleet",
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "replicas": replicas,
+        "completed": len(observed),
+        "client_tokens": client_tokens,
+        "federated_tokens": fed_total,
+        "fleet_tokens_total_gauge": fleet_gauge,
+        "per_replica_tokens": per_replica,
+        "killed_replica": killed,
+        "kill_scrape_s": kill_scrape_s,
+        "scrape_errors": scrape_errors,
+        "stale_replicas": stale,
+        "tenants_client": client_by_tenant,
+        "tenants_federated": fed_by_tenant,
+        "forecast_warn_t": warn_t,
+        "slo_breach_t": breach_t,
+        "forecast_breach_bundles": len(fore.warnings),
+        "fleet": {"scrape_ms": (scrape_timer["mean_s"] * 1e3
+                                if scrape_timer else None),
+                  "scrapes": snap["counters"].get("fleet.scrapes", 0.0)},
+        "failures": failures[:5],
+    }
+    assert not failures, failures[:5]
+    assert len(observed) == requests, (
+        f"only {len(observed)}/{requests} requests completed")
+    assert fed_total == client_tokens, (
+        f"federated token sum {fed_total} != client-observed "
+        f"{client_tokens} — federation must be exact")
+    assert fleet_gauge == sum(per_replica.values()) == client_tokens, (
+        f"fleet.tokens_total {fleet_gauge} != per-replica sum "
+        f"{sum(per_replica.values())} != client {client_tokens}")
+    assert scrape_errors >= 1.0, "killed replica never counted as a scrape error"
+    assert stale == [killed], (
+        f"stale set {stale} != [{killed}] — only the killed replica may "
+        "be marked stale")
+    assert kill_scrape_s < 2 * scraper.timeout_s, (
+        f"scrape after SIGKILL took {kill_scrape_s:.1f}s — must be "
+        "bounded, never a hang")
+    for t_name, n in client_by_tenant.items():
+        assert fed_by_tenant.get(t_name) == n, (
+            f"tenant {t_name}: federated {fed_by_tenant.get(t_name)} != "
+            f"client-observed {n}")
+    assert forecast_led, (
+        f"forecast (warn_t={warn_t}) did not lead the SLO breach "
+        f"(breach_t={breach_t})")
+    assert fore.warnings, "no forecast_breach bundle was dumped"
+    return result
+
+
 def main(argv: list[str]) -> int:
     def arg(flag, default, cast=int):
         return cast(argv[argv.index(flag) + 1]) if flag in argv else default
@@ -1001,7 +1233,12 @@ def main(argv: list[str]) -> int:
                          rounds=arg("--rounds", 2))
         print(json.dumps(out))
         return 0 if out["ok"] else 1
-    if "--replicas" in argv:
+    if "--fleet" in argv:
+        out = run_fleet(requests=arg("--requests", 36),
+                        threads=arg("--threads", 6),
+                        seed=arg("--seed", 0),
+                        replicas=arg("--replicas", 3))
+    elif "--replicas" in argv:
         out = run_replicas(requests=arg("--requests", 48),
                            threads=arg("--threads", 8),
                            seed=arg("--seed", 0),

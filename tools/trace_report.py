@@ -28,6 +28,11 @@ trace id via the ``traceparent`` header; their ``ts`` anchors differ per
 process (perf_counter epochs), so columns are durations, never
 cross-process timestamp differences.
 
+The ``tenant`` column is the bounded tenant label the engine stamped on
+the ``serving.request`` root span (``-`` for untenanted traffic), so a
+trace slices per tenant the same way the registry's ``tenant.*``
+counters do.
+
 TTFT here is time from submission to the end of prefill — the first
 token exists when prefill's last dispatch resolves.  Requests missing a
 ``serving.request`` root (still in flight at export time) are skipped.
@@ -134,6 +139,7 @@ def request_breakdowns(events: list[dict]) -> list[dict]:
             "ttft_ms": ttft_ms,
             "total_ms": root.get("dur", 0.0) / 1e3,
             "tokens": (root.get("args") or {}).get("tokens"),
+            "tenant": (root.get("args") or {}).get("tenant"),
         })
     rows.sort(key=lambda r: r["start_ts_us"])
     return rows
@@ -153,9 +159,10 @@ def render(rows: list[dict], limit: int, slo_ttft_ms: float = 500.0,
             return "-"
         return "MISS" if r["ttft_ms"] > slo_ttft_ms else "ok"
 
-    headers = ("trace_id", "queue", "route", "hops", "prefill", "decode",
-               "segs", "emit", "ttft", "slo", "total", "tokens")
-    cells = [(r["trace_id"][:12], ms(r["queue_wait_ms"]), ms(r["route_ms"]),
+    headers = ("trace_id", "tenant", "queue", "route", "hops", "prefill",
+               "decode", "segs", "emit", "ttft", "slo", "total", "tokens")
+    cells = [(r["trace_id"][:12], str(r.get("tenant") or "-"),
+              ms(r["queue_wait_ms"]), ms(r["route_ms"]),
               str(r["route_hops"] or "-"), ms(r["prefill_ms"]),
               ms(r["decode_ms"]), str(r["decode_segments"]), ms(r["emit_ms"]),
               ms(r["ttft_ms"]), slo(r), ms(r["total_ms"]),
