@@ -73,6 +73,8 @@ class Analyzer:
         self.baseline = baseline or Baseline()
         self.root = root  # paths in findings are made relative to this
         self.errors: list[str] = []   # unparseable files (reported, not fatal)
+        self.visited_files = 0        # files actually analyzed (--diff proof)
+        self.skipped_files = 0        # unreadable/unparseable files skipped
 
     # ------------------------------------------------------------------ files
     def _relpath(self, path: str) -> str:
@@ -94,14 +96,22 @@ class Analyzer:
 
     # ------------------------------------------------------------------ run
     def analyze_source(self, source: str, path: str) -> list[Finding]:
+        self.visited_files += 1
         try:
             module = ModuleInfo(self._relpath(path), source)
-        except SyntaxError as e:
+        except (SyntaxError, ValueError) as e:
+            # hostile input (syntax error, NUL byte): skip the file with a
+            # counted, reported error instead of aborting the whole run
             self.errors.append(f"{path}: {e}")
+            self.skipped_files += 1
             return []
         findings: list[Finding] = []
         for rule in self.rules:
-            findings.extend(rule.check(module))
+            try:
+                findings.extend(rule.check(module))
+            except Exception as e:  # one brittle rule must not kill the run
+                self.errors.append(
+                    f"{path}: rule {rule.id} crashed: {e!r}")
         per_line, file_wide = _parse_pragmas(source)
         for f in findings:
             if _suppressed(file_wide, f.rule) or _suppressed(
@@ -118,8 +128,9 @@ class Analyzer:
             try:
                 with open(path, encoding="utf-8") as fh:
                     source = fh.read()
-            except OSError as e:
+            except (OSError, UnicodeDecodeError) as e:
                 self.errors.append(f"{path}: {e}")
+                self.skipped_files += 1
                 continue
             findings.extend(self.analyze_source(source, path))
         return findings

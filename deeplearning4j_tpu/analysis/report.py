@@ -61,10 +61,14 @@ def to_text(findings: Iterable[Finding], show_all: bool = False) -> str:
     return "\n".join(out)
 
 
-def emit_metrics(findings: Iterable[Finding], registry=None) -> None:
+def emit_metrics(findings: Iterable[Finding], registry=None,
+                 skipped: int = 0) -> None:
     """Publish per-rule gauges through the observability layer.  Imported
     lazily so the analyzer stays usable without jax/observability on the
-    path (e.g. a bare CI box running only the linter)."""
+    path (e.g. a bare CI box running only the linter).  ``skipped`` is the
+    analyzer's unreadable/unparseable file count — published as the
+    ``graftlint.skipped_files`` gauge so hostile inputs degrade visibly
+    instead of silently shrinking coverage."""
     if registry is None:
         try:
             from ..observability import METRICS as registry
@@ -78,3 +82,4 @@ def emit_metrics(findings: Iterable[Finding], registry=None) -> None:
         registry.gauge(f"graftlint.violations.{rule_id}", n)
     registry.gauge("graftlint.violations.total",
                    sum(1 for f in findings if f.status == ACTIVE))
+    registry.gauge("graftlint.skipped_files", skipped)

@@ -1,4 +1,5 @@
-"""The graftlint rule set — twenty hazard classes from this repo's history.
+"""The graftlint rule set — twenty-five hazard classes from this repo's
+history.
 
 | rule  | hazard                                                           |
 |-------|------------------------------------------------------------------|
@@ -60,6 +61,21 @@
 |       | path: `open("w")`/`write_text`/`write_bytes` in `online/` or     |
 |       | `parallel/checkpoint.py` outside the unique-tempfile + fsync +   |
 |       | `os.replace` idiom — a crash mid-write publishes a torn file     |
+| SH01  | collective (`psum`/`pmean`/`all_gather`/`ppermute`/`axis_index`) |
+|       | over an axis name no enclosing `shard_map`/`pmap` context binds  |
+|       | (resolved through the analysis/sharding.py mesh-axis pass)       |
+| SH02  | `PartitionSpec` naming an axis absent from the canonical axis    |
+|       | registry (`parallel/mesh.py` `AXES`) — a typo'd axis fails the   |
+|       | trace on device, or silently replicates                          |
+| SH03  | `shard_map` `in_specs`/`out_specs` arity mismatch against the    |
+|       | wrapped function's signature / literal-tuple returns             |
+| SH04  | argument donated to a jit whose declared `in_shardings` differ   |
+|       | from the sharding the caller placed it with — the implicit       |
+|       | reshard copies, the donation frees the copy source, the aliasing |
+|       | win is silently lost (DON01 with sharding awareness)             |
+| NM01  | hand-rolled softmax/logsumexp in `ops/`/`models/` without max    |
+|       | subtraction (`log(sum(exp))`, `exp/sum(exp)` shapes) — the       |
+|       | blocked-xent and online-softmax kernels are the sanctioned forms |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -82,11 +98,13 @@ from .core import (
     body_statements,
     dotted_name,
     last_segment,
+    literal_int_tuple,
     names_read,
     register,
     statement_targets,
 )
 from .jitinfo import ModuleInfo
+from .sharding import axis_registry, sharding_info
 
 #: callables whose canonical name forces a device->host read of their arg
 _SYNC_CALLS = {
@@ -1715,3 +1733,412 @@ class OnlineDurableWriteRule(Rule):
             if has_replace and has_durable:
                 return True
         return False
+
+
+# ------------------------------------------------------------- sharding tier
+#
+# SH01-SH04 + NM01 consume the analysis/sharding.py mesh-axis pass: axis
+# bindings resolved interprocedurally from Mesh construction through
+# shard_map/pmap wrap sites, the canonical axis registry parsed out of
+# parallel/mesh.py, and literal PartitionSpec signatures.  The runtime
+# twin is analysis/shardguard.py (implicit-reshard detection on live
+# executables) — same split as the concurrency tier's LK rules/lockguard.
+
+
+@register
+class UnboundCollectiveAxisRule(Rule):
+    """SH01 — collective over an axis no enclosing mesh context binds.
+
+    ``lax.psum(x, 'tp')`` inside a function that is only ever
+    ``shard_map``-ed over a ``('dp',)`` mesh cannot succeed: the trace
+    fails with an unbound axis name on device — or, when an outer
+    context happens to bind a same-named axis of different extent, the
+    collective silently reduces over the wrong device group.  The
+    sharding pass resolves which axes each function body is bound under
+    (through ``Mesh``/``make_mesh``/``local_mesh``/``elastic_mesh``,
+    ``shard_map`` and ``pmap(axis_name=...)``, plus one module-internal
+    call level of propagation) and this rule fires when a collective's
+    literal/constant axis argument is missing from that KNOWN set.
+
+    Deliberately confidence-ranked: an axis arriving as a function
+    parameter (the ``parallel/collectives.py`` wrappers), a mesh the
+    pass cannot resolve, or a function never visibly wrapped all leave
+    the binding unknown and keep the rule silent — cross-module wrap
+    sites are the blind spot, and why suppressions exist.
+    """
+
+    id = "SH01"
+    title = "collective over an axis not bound by the enclosing mesh context"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        info = sharding_info(module)
+        for call, chain in info.collective_chains.items():
+            axis_arg = info.collective_axis_arg(call)
+            if axis_arg is None:
+                continue
+            axes_named = info.resolve_axis_tuple(axis_arg)
+            if axes_named is None:
+                continue
+            bound = info.axes_for_chain(chain)
+            if bound is None:
+                continue
+            missing = [a for a in axes_named if a not in bound]
+            if missing:
+                op = last_segment(module.canonical(call.func) or "") or "?"
+                yield self.finding(
+                    module, call,
+                    f"collective `{op}` over axis {missing[0]!r} but the "
+                    f"enclosing shard_map/pmap context only binds "
+                    f"{sorted(bound)} — an unbound axis name fails the "
+                    "trace on device (or reduces over the wrong device "
+                    "group); bind the axis in the mesh or fix the name")
+
+
+@register
+class UnknownAxisNameRule(Rule):
+    """SH02 — ``PartitionSpec`` naming an axis outside the registry.
+
+    Every axis name in this repo comes from ONE table —
+    ``parallel/mesh.py``'s ``DP/TP/PP/SP/EP`` constants and the ``AXES``
+    tuple — which the sharding pass parses directly, so the linter and
+    the runtime can never disagree about which axes exist.  A literal
+    axis string in a ``PartitionSpec``/``P(...)`` call that is not in
+    that table is a typo ('dpx'), a stale rename, or an axis the mesh
+    builder will never create: placement either fails the trace or
+    silently replicates where the author meant to shard.
+
+    The fix for a true finding is the registry hoist: import the
+    constant (``P(DP)``) instead of repeating the string.  Blind spots:
+    names built at runtime, specs threaded through variables, and
+    constants shadowed locally with non-registry values.
+    """
+
+    id = "SH02"
+    title = "PartitionSpec axis name absent from the canonical axis registry"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        registry = axis_registry()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = module.canonical(node.func) or ""
+            if last_segment(canon) != "PartitionSpec":
+                continue
+            for arg in node.args:
+                elts = (arg.elts if isinstance(arg, (ast.Tuple, ast.List))
+                        else [arg])
+                for elt in elts:
+                    if not (isinstance(elt, ast.Constant)
+                            and isinstance(elt.value, str)):
+                        continue
+                    if elt.value not in registry:
+                        yield self.finding(
+                            module, node,
+                            f"PartitionSpec axis {elt.value!r} is not in "
+                            "the canonical axis registry "
+                            f"({', '.join(sorted(registry))}; "
+                            "parallel/mesh.py AXES) — no mesh builder "
+                            "creates this axis, so placement fails the "
+                            "trace or silently replicates; use the mesh.py "
+                            "constants instead of string literals")
+
+
+@register
+class ShardMapSpecArityRule(Rule):
+    """SH03 — ``shard_map`` specs that cannot match the wrapped function.
+
+    ``in_specs`` is zipped positionally against the wrapped function's
+    arguments and ``out_specs`` against its returned tuple; an arity
+    mismatch is a guaranteed trace-time pytree error — but one that only
+    surfaces when the wrap site finally executes, typically deep inside
+    a trainer build.  When the wrapped callable is a module-local def or
+    lambda and the specs are literal tuples, both arities are checkable
+    at lint time; functions with ``*args``, specs threaded through
+    variables, and cross-module targets stay out of scope.  The return
+    check only fires when every return statement is a literal tuple of
+    one consistent length (anything else — a returned variable, a
+    single-value return — is unknowable statically).
+    """
+
+    id = "SH03"
+    title = "shard_map in_specs/out_specs arity mismatch with wrapped fn"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        info = sharding_info(module)
+        for site in info.shard_map_sites:
+            target = site.target
+            if target is None:
+                continue
+            args = target.args
+            if args.vararg is not None:
+                continue
+            params = [a.arg for a in (args.posonlyargs + args.args)]
+            if params and params[0] == "self":
+                params = params[1:]
+            name = getattr(target, "name", "<lambda>")
+            if isinstance(site.in_specs, (ast.Tuple, ast.List)):
+                n_in = len(site.in_specs.elts)
+                lo = len(params) - len(args.defaults or [])
+                if not (lo <= n_in <= len(params)):
+                    yield self.finding(
+                        module, site.call,
+                        f"shard_map in_specs has {n_in} entries but "
+                        f"`{name}` takes {len(params)} positional "
+                        "argument(s) — the spec/argument zip fails at "
+                        "trace time; make the arities match")
+            if isinstance(site.out_specs, (ast.Tuple, ast.List)) \
+                    and isinstance(target, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                lens = set()
+                literal = True
+                returns = [s for s in body_statements(target.body)
+                           if isinstance(s, ast.Return) and s.value is not None]
+                for ret in returns:
+                    if isinstance(ret.value, ast.Tuple):
+                        lens.add(len(ret.value.elts))
+                    else:
+                        literal = False
+                        break
+                if literal and len(lens) == 1:
+                    n_ret = lens.pop()
+                    if n_ret != len(site.out_specs.elts):
+                        yield self.finding(
+                            module, site.call,
+                            f"shard_map out_specs has "
+                            f"{len(site.out_specs.elts)} entries but "
+                            f"`{name}` returns a {n_ret}-tuple — the "
+                            "output pytree/spec zip fails at trace time")
+
+
+@register
+class DonatedReshardRule(Rule):
+    """SH04 — donation through a sharding mismatch (DON01, shard-aware).
+
+    When an argument reaches a ``donate_argnums`` position of a jit
+    whose declared ``in_shardings`` differ from the sharding the caller
+    placed the array with (``jax.device_put(x, NamedSharding(...))``),
+    XLA inserts an implicit reshard copy at the boundary: the donation
+    then aliases the *copy*, the caller's original buffer is still freed
+    — so the memory win the donation promised is silently lost on every
+    step, and any post-call read of the name is use-after-free exactly
+    as in DON01.  Fires at the call site when both shardings are
+    statically literal (``NamedSharding(mesh, P(...))`` placement in the
+    same function, literal ``in_shardings`` tuple on the jit); either
+    side arriving through a variable keeps the rule silent.
+    """
+
+    id = "SH04"
+    title = "donated argument placed with a sharding the jit reshards"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        info = sharding_info(module)
+        jits = self._declared_jits(module, info)
+        if not jits:
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(module, info, jits, fn)
+
+    def _declared_jits(self, module: ModuleInfo, info) -> dict:
+        """basename -> (donate positions, tuple of spec signatures)."""
+        out: dict[str, tuple] = {}
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            canon = module.canonical(call.func) or ""
+            if not (canon in ("jit", "jax.jit") or canon.endswith(".jit")):
+                continue
+            donate = in_sh = None
+            for kw in call.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    donate = literal_int_tuple(kw.value)
+                elif kw.arg == "in_shardings":
+                    in_sh = kw.value
+            if not donate or not isinstance(in_sh, (ast.Tuple, ast.List)):
+                continue
+            sigs = tuple(info.spec_signature(e) for e in in_sh.elts)
+            for target in node.targets:
+                tname = dotted_name(target)
+                if tname is not None:
+                    out[last_segment(tname)] = (donate, sigs)
+        return out
+
+    def _check_function(self, module: ModuleInfo, info, jits,
+                        fn) -> Iterator[Finding]:
+        placed: dict[str, tuple] = {}     # name -> placed spec signature
+        for stmt in body_statements(fn.body):
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call):
+                sig = self._device_put_sig(module, info, stmt.value)
+                for target in stmt.targets:
+                    tname = dotted_name(target)
+                    if tname is None:
+                        continue
+                    if sig is not None:
+                        placed[tname] = sig
+                    else:
+                        placed.pop(tname, None)   # rebound: stale signature
+            for call in _calls_in(stmt):
+                callee = dotted_name(call.func)
+                if callee is None:
+                    continue
+                hit = jits.get(last_segment(callee))
+                if hit is None:
+                    continue
+                donate, sigs = hit
+                for pos in donate:
+                    if pos >= len(call.args):
+                        continue
+                    aname = dotted_name(call.args[pos])
+                    if aname is None:
+                        continue
+                    declared = sigs[pos] if pos < len(sigs) else None
+                    got = placed.get(aname)
+                    if declared is not None and got is not None \
+                            and declared != got:
+                        yield self.finding(
+                            module, call,
+                            f"{aname!r} was placed with sharding "
+                            f"P{got!r} but is donated at position {pos} "
+                            f"of a jit declaring in_shardings P"
+                            f"{declared!r} — the implicit reshard copies "
+                            "and the donation frees the original without "
+                            "aliasing it: the memory win is lost and any "
+                            "later read is use-after-free; place with the "
+                            "jit's sharding (or fix the declaration)")
+
+    def _device_put_sig(self, module: ModuleInfo, info, call: ast.Call):
+        canon = module.canonical(call.func) or ""
+        if last_segment(canon) != "device_put":
+            return None
+        sh = call.args[1] if len(call.args) > 1 else None
+        for kw in call.keywords:
+            if kw.arg in ("device", "sharding"):
+                sh = kw.value
+        return None if sh is None else info.spec_signature(sh)
+
+
+@register
+class UnstableReductionRule(Rule):
+    """NM01 — hand-rolled softmax/logsumexp without max subtraction.
+
+    ``log(sum(exp(x)))`` and ``exp(x)/sum(exp(x))`` overflow to inf the
+    moment one logit exceeds ~88 (f32) or ~11 (bf16) — which real logits
+    do.  The sanctioned implementations in this tree are the blocked-
+    xent kernel (``ops/pallas/xent.py``), the online-softmax attention
+    kernels (``ops/flash_attention.py``, ``ops/pallas/attention.py``)
+    and ``jax.scipy.special.logsumexp`` / ``jax.nn.softmax`` — all of
+    which subtract a running or global max first.  Scoped to ``ops/``
+    and ``models/``, the rule fires on three shapes: direct
+    ``log(...sum(exp(...))...)`` nesting, a division whose numerator
+    holds ``exp`` and denominator a ``sum`` of ``exp``, and a ``log``/
+    division of a local name bound from a sum-of-exp — in each case
+    only when the enclosing function shows NO max/clip evidence at all
+    (any ``max``/``maximum``/``clip``/``logsumexp``/``softmax`` call
+    quiets it, which is what makes the online-softmax kernels pass).
+
+    Blind spots: guards living in a helper the function calls, and
+    reductions split across functions.  A deliberately unguarded form
+    (inputs bounded by construction) gets ``# graftlint: disable=NM01``
+    with the bound stated.
+    """
+
+    id = "NM01"
+    title = "numerically unstable reduction (softmax/logsumexp w/o max)"
+
+    _GUARDS = {"max", "maximum", "clip", "pmax", "logsumexp", "softmax",
+               "log_softmax", "amax", "nanmax"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if not any(seg in path for seg in ("ops/", "models/")):
+            return
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._has_guard(module, fn):
+                    continue
+                yield from self._check_function(module, fn)
+
+    def _has_guard(self, module: ModuleInfo, fn) -> bool:
+        for call in _calls_in(fn):
+            canon = module.canonical(call.func) or dotted_name(call.func) or ""
+            base = (last_segment(canon) or canon).lstrip("_")
+            if base in self._GUARDS:
+                return True
+        return False
+
+    def _is_exp(self, module: ModuleInfo, call: ast.Call) -> bool:
+        canon = module.canonical(call.func) or ""
+        return last_segment(canon) == "exp"
+
+    def _contains_exp(self, module: ModuleInfo, node: ast.AST) -> bool:
+        return any(self._is_exp(module, c) for c in _calls_in(node))
+
+    def _is_sum_of_exp(self, module: ModuleInfo, node: ast.AST,
+                       exp_names=frozenset()) -> bool:
+        """``sum(..exp..)`` call or ``(..exp..).sum()`` method call,
+        where "exp" is a literal exp call or a name bound from one."""
+        if not isinstance(node, ast.Call):
+            return False
+        canon = module.canonical(node.func) or ""
+        if last_segment(canon) != "sum":
+            return False
+
+        def exppy(n: ast.AST) -> bool:
+            return (self._contains_exp(module, n)
+                    or bool(names_read(n) & exp_names))
+
+        scope = (node.func.value if isinstance(node.func, ast.Attribute)
+                 else None)
+        return any(exppy(a) for a in node.args) \
+            or (scope is not None and exppy(scope))
+
+    def _contains_sum_of_exp(self, module: ModuleInfo, node: ast.AST,
+                             exp_names=frozenset()) -> bool:
+        return any(self._is_sum_of_exp(module, n, exp_names)
+                   for n in ast.walk(node) if isinstance(n, ast.Call))
+
+    def _check_function(self, module: ModuleInfo, fn) -> Iterator[Finding]:
+        # names bound (in this function) to an exp / to a sum-of-exp
+        exp_names: set[str] = set()
+        sumexp_names: set[str] = set()
+        for stmt in body_statements(fn.body):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if self._contains_sum_of_exp(module, stmt.value, exp_names):
+                for target in stmt.targets:
+                    sumexp_names.update(assigned_names(target))
+            elif self._contains_exp(module, stmt.value):
+                for target in stmt.targets:
+                    exp_names.update(assigned_names(target))
+
+        def holds_exp(node: ast.AST) -> bool:
+            return (self._contains_exp(module, node)
+                    or bool(names_read(node) & exp_names))
+
+        def holds_sumexp(node: ast.AST) -> bool:
+            return (self._contains_sum_of_exp(module, node, exp_names)
+                    or bool(names_read(node) & sumexp_names))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                canon = module.canonical(node.func) or ""
+                if last_segment(canon) == "log" and node.args \
+                        and holds_sumexp(node.args[0]):
+                    yield self.finding(
+                        module, node,
+                        "hand-rolled logsumexp: log of a sum of exp "
+                        "with no max subtraction in reach — overflows "
+                        "to inf on realistic logits; use "
+                        "jax.scipy.special.logsumexp (or subtract the "
+                        "max first, like the blocked-xent kernel)")
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Div):
+                if holds_sumexp(node.right) and holds_exp(node.left):
+                    yield self.finding(
+                        module, node,
+                        "hand-rolled softmax: exp(x) divided by a sum of "
+                        "exp with no max subtraction in reach — overflows "
+                        "to inf on realistic logits; use jax.nn.softmax "
+                        "(or the online-softmax kernels in ops/)")

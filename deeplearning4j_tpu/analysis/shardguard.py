@@ -1,0 +1,247 @@
+"""Runtime sharding drift detection: the dynamic half of the SH rules.
+
+The static sharding rules (SH01-SH04) reason about the *code*; this
+module watches the *dispatches*.  ``ShardGuard.wrap(site, fn, ...)``
+returns a call-compatible wrapper around a jitted step function that,
+while the guard is enabled, diffs the shardings of the concrete arrays
+crossing the call boundary against what the site *placed*:
+
+- **explicit mode** (``in_shardings``/``out_shardings`` given): each
+  positional argument's array leaves must carry a sharding equivalent to
+  the declared ``NamedSharding`` — the exact placements the caller
+  installed with ``device_put``.  A mismatch means XLA will silently
+  reshard (an all-to-all per dispatch) before the program even runs:
+  the classic "training still converges, 30% slower" bug.
+- **baseline mode** (no expectations): the first enabled call captures
+  each leaf's sharding as the site's baseline; later calls that arrive
+  with a different sharding are flagged as drift.  This is the right
+  mode for shard_map'd ZeRO steps and the serving decode dispatch, where
+  the placement is an emergent property of the program rather than a
+  declared contract.
+
+Each mismatch is recorded once per (site, direction, leaf) as a
+:class:`Violation` and counted per occurrence into the
+``shardguard.violations.resharded_input`` / ``.resharded_output``
+gauges.  Disabled, the wrapper costs one attribute check per dispatch —
+it is always installed, never hot.
+
+Opt-in only: tests use ``@pytest.mark.shardguard`` (conftest enables
+around the test and asserts zero violations), ``DL4J_TPU_SHARDGUARD=1``
+enables a whole session, and ``tools/chaos_smoke.py --shardguard`` /
+``tools/perf_smoke.py --shardguard`` run the smokes instrumented.
+
+Known limits: only positional arguments are checked (every wrapped site
+in this repo dispatches positionally); shardings are compared with
+``Sharding.is_equivalent_to`` so a replicated ``NamedSharding`` and a
+``SingleDeviceSharding`` on a 1-device mesh compare equal, as they
+should — the guard flags *placement* changes, not representation ones.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import os
+import threading
+
+ENV_SHARDGUARD = "DL4J_TPU_SHARDGUARD"
+
+_ON_VALUES = {"1", "on", "true", "yes", "enabled"}
+
+
+def enabled_from_env() -> bool:
+    """True when ``DL4J_TPU_SHARDGUARD`` asks for session-wide guarding."""
+    return os.environ.get(ENV_SHARDGUARD, "").strip().lower() in _ON_VALUES
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One runtime finding — a dispatch whose shardings drifted."""
+
+    kind: str                    # "resharded-input" | "resharded-output"
+    site: str                    # wrap() label, e.g. "train.sync_step"
+    message: str
+    details: tuple = ()          # (leaf path, expected, actual)
+
+    def __str__(self) -> str:    # report/assert readability
+        return f"[{self.kind}] {self.site}: {self.message}"
+
+
+def _leaves_with_paths(tree):
+    """(path string, leaf) pairs for array leaves (lazy jax import so the
+    analysis package stays importable on a bare CI box running only the
+    linter)."""
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if hasattr(leaf, "sharding") and hasattr(leaf, "ndim"):
+            out.append((jax.tree_util.keystr(path), leaf))
+    return out
+
+
+def _equivalent(expected, actual, ndim: int) -> bool:
+    """Placement equivalence; unknowns compare equal (never false-fire)."""
+    if expected is None or actual is None:
+        return True
+    try:
+        return expected.is_equivalent_to(actual, ndim)
+    except Exception:
+        try:
+            return str(expected) == str(actual)
+        except Exception:
+            return True
+
+
+class ShardGuard:
+    """The detector: per-site sharding expectations + drift baselines.
+
+    A process-wide singleton (:data:`SHARDGUARD`) so trainer, serving
+    engine and tests all feed one findings list; per-wrapper baselines
+    live on the wrapper closure, so two trainer instances (or two ZeRO
+    stages) never cross-contaminate each other's captured placements.
+    """
+
+    def __init__(self) -> None:
+        self._meta = threading.Lock()
+        self._enabled = False
+        self._violations: list[Violation] = []
+        self._reported: set[tuple] = set()     # (site, kind, path) dedup
+        self._counts = {"resharded-input": 0, "resharded-output": 0}
+
+    # ------------------------------------------------------------- switch
+    def enable(self) -> "ShardGuard":
+        self._enabled = True
+        return self
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    # conftest symmetry with lockguard's install/uninstall vocabulary
+    install = enable
+    uninstall = disable
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # --------------------------------------------------------------- wrap
+    def wrap(self, site: str, fn, in_shardings=None, out_shardings=None):
+        """Wrap a jitted step function for dispatch-time sharding diffs.
+
+        ``in_shardings``/``out_shardings`` are per-position expectations
+        (``None`` entries skip that position); omit both for baseline
+        mode.  The wrapper forwards ``.lower`` so XLA cost capture keeps
+        working, and checks inputs BEFORE the call — donated buffers are
+        gone afterwards.
+        """
+        guard = self
+        baseline: dict[tuple, tuple] = {}   # (io, path) -> (sharding, ndim)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if guard._enabled:
+                guard._check(site, "input", args, in_shardings, baseline)
+            out = fn(*args, **kwargs)
+            if guard._enabled:
+                outs = out if isinstance(out, tuple) else (out,)
+                guard._check(site, "output", outs, out_shardings, baseline)
+            return out
+
+        if hasattr(fn, "lower"):
+            wrapper.lower = fn.lower
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    # -------------------------------------------------------------- diffs
+    def _check(self, site: str, io: str, values: tuple, expected,
+               baseline: dict) -> None:
+        kind = f"resharded-{io}"
+        for pos, value in enumerate(values):
+            exp = None
+            if expected is not None:
+                if pos >= len(expected):
+                    continue
+                exp = expected[pos]
+                if exp is None:
+                    continue
+            for path, leaf in _leaves_with_paths(value):
+                key = (io, f"[{pos}]{path}")
+                actual = leaf.sharding
+                if expected is None:
+                    with self._meta:
+                        stored = baseline.get(key)
+                        if stored is None:
+                            baseline[key] = (actual, leaf.ndim)
+                            continue
+                    want, _ = stored
+                else:
+                    want = exp
+                if not _equivalent(want, actual, leaf.ndim):
+                    self._record(site, kind, key[1], want, actual)
+
+    def _record(self, site: str, kind: str, path: str,
+                want, actual) -> None:
+        with self._meta:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            dedup = (site, kind, path)
+            if dedup in self._reported:
+                return
+            self._reported.add(dedup)
+            self._violations.append(Violation(
+                kind=kind, site=site,
+                message=(f"arg {path} arrived as {actual} but the site "
+                         f"placed {want} — XLA reshards this array on "
+                         f"every dispatch"),
+                details=(path, str(want), str(actual))))
+
+    # ----------------------------------------------------------- results
+    def violations(self) -> list[Violation]:
+        with self._meta:
+            return list(self._violations)
+
+    def counts(self) -> dict[str, int]:
+        """Per-kind OCCURRENCE counts (violations() is deduped per leaf)."""
+        with self._meta:
+            return dict(self._counts)
+
+    def reset(self) -> None:
+        """Clear findings and occurrence counts; enable state is kept.
+        Per-wrapper baselines are NOT cleared (they die with the step
+        function they describe)."""
+        with self._meta:
+            self._violations.clear()
+            self._reported.clear()
+            self._counts = {"resharded-input": 0, "resharded-output": 0}
+
+    def report(self) -> str:
+        vs = self.violations()
+        if not vs:
+            return "shardguard: clean (0 violations)"
+        lines = [f"shardguard: {len(vs)} violation(s)"]
+        lines += [f"  {v}" for v in vs]
+        return "\n".join(lines)
+
+    def emit_metrics(self) -> None:
+        """Publish occurrence counts on the PR 1 metrics registry."""
+        from ..observability import METRICS
+        counts = self.counts()
+        for kind in ("resharded-input", "resharded-output"):
+            METRICS.gauge(
+                "shardguard.violations." + kind.replace("-", "_"),
+                counts.get(kind, 0))
+
+
+SHARDGUARD = ShardGuard()
+
+
+@contextlib.contextmanager
+def shardguard_active(guard: ShardGuard | None = None):
+    """Enable around a block, disable after; yields the guard."""
+    g = guard or SHARDGUARD
+    g.enable()
+    try:
+        yield g
+    finally:
+        g.disable()

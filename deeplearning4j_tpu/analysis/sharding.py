@@ -1,0 +1,442 @@
+"""Sharding pass: mesh-axis bindings resolved per module, interprocedurally.
+
+The SH rules need one fact the raw AST does not carry: *which mesh axes
+are in scope* at a given expression.  Axis names enter a program in
+exactly three ways in this codebase —
+
+- a ``jax.sharding.Mesh(devices, axis_names)`` construction,
+- the ``parallel/mesh.py`` helpers (``make_mesh``/``local_mesh``/
+  ``elastic_mesh``/``shrink_mesh``/``grow_mesh``) that wrap it,
+- a ``shard_map(fn, mesh=..., ...)`` / ``pmap(fn, axis_name=...)`` site
+  that binds those axes over ``fn``'s body —
+
+and this module threads them through all three: mesh-producing calls and
+assignments are resolved to axis sets, wrap sites bind those sets onto
+the wrapped function definitions (lambdas included), and bound axes
+propagate one module-internal call level at a time to a fixed point, so
+a helper invoked from a ``shard_map``-ed step inherits the step's axes.
+
+Everything is deliberately *confidence-ranked*: a binding is either a
+known ``frozenset`` of axis names, ``None`` ("wrapped, but through a
+mesh we cannot resolve" — e.g. a mesh arriving as a parameter), or
+absent ("never visibly wrapped").  SH01 only fires on KNOWN bindings;
+unknown silences the rule rather than guessing.
+
+The canonical axis-name registry is parsed straight out of
+``parallel/mesh.py`` (the ``DP, TP, PP, SP, EP = ...`` constants and the
+``AXES`` table) so the linter and the runtime can never disagree about
+which axis names exist.  ``set_axis_registry`` is the test hook.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from .core import dotted_name, last_segment
+from .jitinfo import ModuleInfo
+
+#: last-resort axis table, used only when parallel/mesh.py is unreadable
+_FALLBACK_AXES = ("dp", "tp", "pp", "sp", "ep")
+
+#: collective primitives (and this repo's same-named wrappers) that take
+#: a mesh-axis name argument
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute", "pshuffle",
+    "all_to_all", "axis_index", "psum_scatter",
+})
+
+#: collectives whose FIRST positional argument is the axis name
+_AXIS_FIRST = frozenset({"axis_index"})
+
+#: sentinel distinguishing "never wrapped" from "wrapped, axes unknown"
+_UNWRAPPED = object()
+
+_registry_cache: tuple[frozenset, dict] | None = None
+_registry_override: tuple[frozenset, dict] | None = None
+
+
+def set_axis_registry(axes) -> None:
+    """Test hook: replace the parsed mesh.py axis table (None restores)."""
+    global _registry_override
+    if axes is None:
+        _registry_override = None
+    else:
+        axes = tuple(axes)
+        _registry_override = (frozenset(axes),
+                              {a.upper(): a for a in axes})
+
+
+def _parse_mesh_module() -> tuple[frozenset, dict]:
+    """(axis-name set, constant-name -> axis-name) from parallel/mesh.py."""
+    consts: dict[str, str] = {}
+    axes: list[str] = []
+    path = (pathlib.Path(__file__).resolve().parents[1]
+            / "parallel" / "mesh.py")
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return frozenset(_FALLBACK_AXES), {a.upper(): a for a in _FALLBACK_AXES}
+    for stmt in tree.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for target in targets:
+            # DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
+            if isinstance(target, ast.Tuple) and isinstance(value, ast.Tuple) \
+                    and len(target.elts) == len(value.elts):
+                for t, v in zip(target.elts, value.elts):
+                    if isinstance(t, ast.Name) and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        consts[t.id] = v.value
+            # AXES: tuple[str, ...] = (DP, TP, PP, SP, EP)
+            elif isinstance(target, ast.Name) and target.id == "AXES" \
+                    and isinstance(value, (ast.Tuple, ast.List)):
+                for v in value.elts:
+                    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                        axes.append(v.value)
+                    elif isinstance(v, ast.Name) and v.id in consts:
+                        axes.append(consts[v.id])
+    if not axes:
+        axes = list(consts.values()) or list(_FALLBACK_AXES)
+    return frozenset(axes), consts
+
+
+def axis_registry() -> frozenset:
+    """The canonical set of mesh-axis names (SH02's ground truth)."""
+    return _registry_tables()[0]
+
+
+def axis_constants() -> dict:
+    """Constant name -> axis name (``DP`` -> ``"dp"``) from mesh.py."""
+    return _registry_tables()[1]
+
+
+def _registry_tables() -> tuple[frozenset, dict]:
+    global _registry_cache
+    if _registry_override is not None:
+        return _registry_override
+    if _registry_cache is None:
+        _registry_cache = _parse_mesh_module()
+    return _registry_cache
+
+
+class ShardMapSite:
+    """One ``shard_map(fn, ...)`` call with its resolved pieces."""
+
+    __slots__ = ("call", "target", "mesh_axes", "in_specs", "out_specs")
+
+    def __init__(self, call, target, mesh_axes, in_specs, out_specs):
+        self.call = call            # the shard_map ast.Call
+        self.target = target        # wrapped FunctionDef/Lambda, or None
+        self.mesh_axes = mesh_axes  # frozenset | None
+        self.in_specs = in_specs    # ast node or None
+        self.out_specs = out_specs  # ast node or None
+
+
+class ShardingInfo:
+    """Per-module axis-binding facts, computed once and cached on the
+    :class:`ModuleInfo` (see :func:`sharding_info`)."""
+
+    def __init__(self, module: ModuleInfo):
+        self.module = module
+        #: local names (incl. dotted like ``self.mesh``) -> axis sets
+        self.mesh_axes: dict[str, frozenset | None] = {}
+        #: def/lambda -> frozenset (known axes) | None (wrapped, unknown)
+        self.bound: dict[ast.AST, object] = {}
+        self.shard_map_sites: list[ShardMapSite] = []
+        #: every collective call node -> its enclosing def/lambda chain
+        self.collective_chains: dict[ast.Call, tuple] = {}
+        self._defs_by_name: dict[str, list] = {}
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs_by_name.setdefault(fn.name, []).append(fn)
+        self._collect_mesh_vars()
+        self._collect_bindings()
+        self._propagate()
+        self._collect_collectives()
+
+    # ------------------------------------------------------------- axes
+    def resolve_axis(self, node) -> str | None:
+        """Literal/constant-resolved axis name, else None."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = self.module.canonical(node) or dotted_name(node)
+        if not name:
+            return None
+        base = last_segment(name)
+        consts = axis_constants()
+        if base in consts and (name == base or name.endswith(f"mesh.{base}")):
+            return consts[base]
+        return None
+
+    def resolve_axis_tuple(self, node) -> tuple | None:
+        """All-resolvable tuple/list of axis names, else None."""
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                axis = self.resolve_axis(elt)
+                if axis is None:
+                    return None
+                out.append(axis)
+            return tuple(out)
+        axis = self.resolve_axis(node)
+        return None if axis is None else (axis,)
+
+    def spec_signature(self, node):
+        """Canonical signature of a literal sharding expression —
+        ``NamedSharding(mesh, P('dp'))`` / ``P('dp', None)`` become
+        ``('dp',)`` / ``('dp', None)`` (tuple entries for multi-axis
+        dims), ``replicated(mesh)`` / ``P()`` become ``()``.  None when
+        the expression is not statically resolvable (a variable, a
+        helper call with runtime axes)."""
+        if not isinstance(node, ast.Call):
+            return None
+        canon = self.module.canonical(node.func) or ""
+        base = last_segment(canon)
+        if base == "NamedSharding":
+            spec = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "spec":
+                    spec = kw.value
+            return None if spec is None else self.spec_signature(spec)
+        if base == "replicated":
+            return ()
+        if base == "PartitionSpec":
+            out = []
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and arg.value is None:
+                    out.append(None)
+                    continue
+                axes = self.resolve_axis_tuple(arg)
+                if axes is None:
+                    return None
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    out.append(axes)
+                else:
+                    out.append(axes[0])
+            return tuple(out)
+        return None
+
+    # -------------------------------------------------------- mesh vars
+    def _axes_from_mesh_call(self, call: ast.Call):
+        """frozenset | None (unknown) | _UNWRAPPED (not a mesh call)."""
+        canon = self.module.canonical(call.func) or ""
+        base = last_segment(canon)
+        if base == "Mesh":
+            axis_arg = call.args[1] if len(call.args) > 1 else None
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    axis_arg = kw.value
+            if axis_arg is None:
+                return None
+            axes = self.resolve_axis_tuple(axis_arg)
+            return None if axes is None else frozenset(axes)
+        if base == "make_mesh":
+            return frozenset(axis_registry())
+        if base in ("local_mesh", "elastic_mesh"):
+            axis_arg = None
+            for kw in call.keywords:
+                if kw.arg == "axis":
+                    axis_arg = kw.value
+            if axis_arg is None and len(call.args) > 1:
+                axis_arg = call.args[1]
+            if axis_arg is None:
+                return frozenset({axis_constants().get("DP", "dp")})
+            axis = self.resolve_axis(axis_arg)
+            return None if axis is None else frozenset({axis})
+        if base in ("shrink_mesh", "grow_mesh"):
+            # dp-only by contract (see parallel/mesh.py)
+            return frozenset({axis_constants().get("DP", "dp")})
+        return _UNWRAPPED
+
+    def _collect_mesh_vars(self) -> None:
+        for node in ast.walk(self.module.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            axes = self._axes_from_mesh_call(node.value)
+            if axes is _UNWRAPPED:
+                continue
+            for target in node.targets:
+                name = dotted_name(target)
+                if name is not None:
+                    # two assigns with different axes -> unknown
+                    prior = self.mesh_axes.get(name, axes)
+                    self.mesh_axes[name] = axes if prior == axes else None
+
+    def _mesh_arg_axes(self, node):
+        """Axis set of a ``mesh=`` argument expression (frozenset|None)."""
+        if isinstance(node, ast.Call):
+            axes = self._axes_from_mesh_call(node)
+            return None if axes is _UNWRAPPED else axes
+        name = dotted_name(node)
+        if name is not None and name in self.mesh_axes:
+            return self.mesh_axes[name]
+        return None
+
+    # --------------------------------------------------------- bindings
+    def _def_for_name(self, basename: str, lineno: int):
+        """The local def ``basename`` refers to near ``lineno``.  With
+        several same-named defs (nested-builder ``local`` idiom), the
+        closest one defined ABOVE the reference wins — the reference
+        pattern is ``def local(...)`` followed by ``shard_map(local)``
+        a few lines later in the same builder."""
+        cands = self._defs_by_name.get(basename)
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        above = [d for d in cands if d.lineno <= lineno]
+        return max(above, key=lambda d: d.lineno) if above else None
+
+    def _wrap_target(self, expr, lineno: int):
+        """The def/lambda a wrap site's first argument refers to."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Call) and expr.args:
+            # shard_map(jax.checkpoint(step), ...) style nesting
+            return self._wrap_target(expr.args[0], lineno)
+        name = dotted_name(expr)
+        if name is not None:
+            return self._def_for_name(last_segment(name), lineno)
+        return None
+
+    def _bind(self, target, axes) -> None:
+        if target is None:
+            return
+        prior = self.bound.get(target, _UNWRAPPED)
+        if axes is None or prior is None:
+            self.bound[target] = None       # unknown dominates
+        elif prior is _UNWRAPPED:
+            self.bound[target] = frozenset(axes)
+        else:
+            self.bound[target] = prior | frozenset(axes)
+
+    def _collect_bindings(self) -> None:
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self.module.canonical(node.func) or ""
+            base = last_segment(canon)
+            if base == "shard_map":
+                target = (self._wrap_target(node.args[0], node.lineno)
+                          if node.args else None)
+                mesh_arg = node.args[1] if len(node.args) > 1 else None
+                in_specs = node.args[2] if len(node.args) > 2 else None
+                out_specs = node.args[3] if len(node.args) > 3 else None
+                for kw in node.keywords:
+                    if kw.arg == "mesh":
+                        mesh_arg = kw.value
+                    elif kw.arg == "in_specs":
+                        in_specs = kw.value
+                    elif kw.arg == "out_specs":
+                        out_specs = kw.value
+                axes = (self._mesh_arg_axes(mesh_arg)
+                        if mesh_arg is not None else None)
+                self._bind(target, axes)
+                self.shard_map_sites.append(
+                    ShardMapSite(node, target, axes, in_specs, out_specs))
+            elif base == "pmap" or canon.endswith(".pmap"):
+                target = (self._wrap_target(node.args[0], node.lineno)
+                          if node.args else None)
+                axis_arg = None
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_arg = kw.value
+                if axis_arg is None:
+                    self._bind(target, None)    # unnamed axis: unknown
+                else:
+                    axis = self.resolve_axis(axis_arg)
+                    self._bind(target,
+                               None if axis is None else frozenset({axis}))
+
+    def _propagate(self) -> None:
+        """Bound axes flow to module-local defs called from bound defs —
+        the interprocedural half, run to a (bounded) fixed point."""
+        for _ in range(len(self._defs_by_name) + 1):
+            changed = False
+            for fn, axes in list(self.bound.items()):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                       ast.Lambda)):
+                    continue
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    callee = dotted_name(call.func)
+                    if callee is None:
+                        continue
+                    child = self._def_for_name(last_segment(callee),
+                                               call.lineno)
+                    if child is None or child is fn:
+                        continue
+                    prior = self.bound.get(child, _UNWRAPPED)
+                    if axes is None:
+                        if prior is not None:
+                            self.bound[child] = None
+                            changed = True
+                    elif prior is _UNWRAPPED:
+                        self.bound[child] = frozenset(axes)
+                        changed = True
+                    elif prior is not None and not (axes <= prior):
+                        self.bound[child] = prior | axes
+                        changed = True
+            if not changed:
+                return
+
+    # ------------------------------------------------------ collectives
+    def collective_axis_arg(self, call: ast.Call):
+        """The axis-name argument expression of a collective call, or
+        None when ``call`` is not a collective / has no axis argument."""
+        canon = self.module.canonical(call.func) or ""
+        base = last_segment(canon)
+        if base not in COLLECTIVES:
+            return None
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                return kw.value
+        idx = 0 if base in _AXIS_FIRST else 1
+        if idx < len(call.args):
+            return call.args[idx]
+        return None
+
+    def _collect_collectives(self) -> None:
+        def walk(node, chain):
+            for child in ast.iter_child_nodes(node):
+                sub = chain
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    sub = chain + (child,)
+                if isinstance(child, ast.Call):
+                    canon = self.module.canonical(child.func) or ""
+                    if last_segment(canon) in COLLECTIVES:
+                        self.collective_chains[child] = chain
+                walk(child, sub)
+
+        walk(self.module.tree, ())
+
+    def axes_for_chain(self, chain) -> frozenset | None:
+        """Known bound axes over a lexical def chain; None = unknown
+        (an unresolvable wrap in the chain, or nothing wrapped at all)."""
+        known: set = set()
+        any_known = False
+        for fn in chain:
+            b = self.bound.get(fn, _UNWRAPPED)
+            if b is None:
+                return None
+            if b is not _UNWRAPPED:
+                known |= b
+                any_known = True
+        return frozenset(known) if any_known else None
+
+
+def sharding_info(module: ModuleInfo) -> ShardingInfo:
+    """The module's (cached) sharding pass result."""
+    info = getattr(module, "_sharding_info", None)
+    if info is None or info.module is not module:
+        info = ShardingInfo(module)
+        module._sharding_info = info
+    return info

@@ -50,7 +50,7 @@ import numpy as np
 
 from ..observability import FLIGHTREC, METRICS, trace
 from ..resilience.faults import FAULTS, corrupt_file
-from .mesh import MeshMismatchError
+from .mesh import DP, MeshMismatchError
 from .zero import flat_padded_size, host_flat_to_natural
 
 
@@ -253,7 +253,7 @@ class CheckpointManager:
                 # restore (and the MeshMismatchError contract) keys off.
                 # ``layout`` is "natural" (gathered, width-agnostic) or
                 # "flat" (padded P('dp') vectors of the save-side width).
-                "topology": {"dp": dp_width, "zero_stage": zero_stage,
+                "topology": {DP: dp_width, "zero_stage": zero_stage,
                              "layout": layout or "natural"},
                 # per-file SHA-256 manifest: verify() recomputes these; a
                 # checkpoint whose payloads do not match is never restored
@@ -439,7 +439,7 @@ class CheckpointManager:
         meta = json.loads((ckpt_dir / "meta.json").read_text())
         topo = meta.get("topology") or {}
         extra = meta.get("extra") or {}
-        saved_dp = topo.get("dp")
+        saved_dp = topo.get(DP)
         if saved_dp is None:  # pre-topology checkpoints stamped via extra
             saved_dp = extra.get("saved_dp")
         zero_stage = topo.get("zero_stage")

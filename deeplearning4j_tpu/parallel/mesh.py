@@ -25,6 +25,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DP, TP, PP, SP, EP = "dp", "tp", "pp", "sp", "ep"
 
+#: Canonical axis-name registry — THE one table every PartitionSpec,
+#: shard_map and collective in this repo draws axis names from.  The
+#: static sharding pass (analysis/sharding.py) parses this module for
+#: exactly these assignments, so an axis name that is not here fails
+#: lint (SH02) before it fails a trace on device.
+AXES: tuple[str, ...] = (DP, TP, PP, SP, EP)
+
+#: role of each axis, for error messages and operator docs
+AXIS_ROLES: dict[str, str] = {
+    DP: "data",
+    TP: "tensor/model",
+    PP: "pipeline stages",
+    SP: "sequence (ring attention / context parallel)",
+    EP: "expert",
+}
+
 
 class MeshMismatchError(RuntimeError):
     """A checkpoint written at one dp width met a mesh of another width

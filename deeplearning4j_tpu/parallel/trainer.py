@@ -60,6 +60,7 @@ except ImportError:  # pragma: no cover - older jax
                               out_specs=out_specs, check_rep=check_vma)
 
 from ..analysis.runtime import allow_transfers, hot_loop_guard
+from ..analysis.shardguard import SHARDGUARD
 from ..datasets.dataset import DataSet
 from ..resilience.faults import FAULTS, DeviceLossError, DivergenceError
 from ..observability import COSTS, METRICS, NOOP_SPAN, enabled as _obs_enabled
@@ -325,11 +326,20 @@ class DataParallelTrainer:
             params = tfm.apply_updates(params, updates)
             return params, tstate, loss
 
-        return jax.jit(
-            step,
+        # shardguard (off by default: one flag check per dispatch) diffs
+        # the arrays crossing this boundary against the very shardings the
+        # jit declares — a drifted device_put upstream means XLA reshards
+        # on every step instead of failing loudly
+        return SHARDGUARD.wrap(
+            "train.sync_step",
+            jax.jit(
+                step,
+                in_shardings=(rep, rep, batch_sh, batch_sh, rep, rep, rep),
+                out_shardings=(rep, rep, rep),
+                donate_argnums=(0, 1),
+            ),
             in_shardings=(rep, rep, batch_sh, batch_sh, rep, rep, rep),
             out_shardings=(rep, rep, rep),
-            donate_argnums=(0, 1),
         )
 
     def _build_zero_step(self):
@@ -414,7 +424,11 @@ class DataParallelTrainer:
             out_specs=(param_spec, P(DP), P()),
             check_vma=False,
         )
-        return jax.jit(smapped, donate_argnums=(0, 1))
+        # baseline mode: the ZeRO placements are emergent (stage-dependent
+        # param spec), so the first dispatch captures them and later drift
+        # — not the initial layout — is the violation
+        return SHARDGUARD.wrap(
+            "train.zero_step", jax.jit(smapped, donate_argnums=(0, 1)))
 
     def _build_local_step(self):
         """HogWild-approx local step: runs independently per dp shard."""

@@ -61,6 +61,7 @@ import numpy as np
 from jax import lax
 
 from ..analysis.runtime import allow_transfers, hot_loop_guard
+from ..analysis.shardguard import SHARDGUARD
 from ..models.transformer import (decode_step, decode_step_paged,
                                   decode_window, decode_window_paged,
                                   gather_paged_layer, init_decode_cache,
@@ -237,9 +238,15 @@ class InferenceEngine:
         # device-resident chaos flags, built OUTSIDE the hot loop — the
         # decode segment must not upload scalars under hot_loop_guard
         self._garble = (jnp.int32(0), jnp.int32(1))
-        self._step_fn = jax.jit(
-            self._build_step(),
-            donate_argnums=(2,) if cfg.speculative else (1,))
+        # shardguard baseline mode: the first decode dispatch captures the
+        # params/state placements; a later dispatch arriving differently
+        # placed (e.g. a reload that device_puts onto the wrong sharding)
+        # is counted as implicit resharding.  One flag check when off.
+        self._step_fn = SHARDGUARD.wrap(
+            "serving.decode_step",
+            jax.jit(
+                self._build_step(),
+                donate_argnums=(2,) if cfg.speculative else (1,)))
         self._step_compiled = False
         self._admit_fns: dict[int, Callable] = {}    # guarded-by: self._lock
         self._slots: dict[int, _Slot] = {}           # guarded-by: self._lock

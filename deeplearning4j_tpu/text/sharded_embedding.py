@@ -192,7 +192,7 @@ class ShardedWord2Vec(Word2Vec):
     def __init__(self, sentences=None, *, mesh: Mesh, **kw):
         super().__init__(sentences, **kw)
         if EP not in mesh.shape or mesh.shape[EP] < 1:
-            raise ValueError("mesh must carry an 'ep' axis")
+            raise ValueError(f"mesh must carry an {EP!r} axis")
         self.mesh = mesh
         self._hs_fn = self._ns_fn = None
 
@@ -230,7 +230,7 @@ class ShardedGlove(Glove):
     def __init__(self, sentences=None, *, mesh: Mesh, **kw):
         super().__init__(sentences, **kw)
         if EP not in mesh.shape or mesh.shape[EP] < 1:
-            raise ValueError("mesh must carry an 'ep' axis")
+            raise ValueError(f"mesh must carry an {EP!r} axis")
         self.mesh = mesh
         self._step_fn = None
         self._n_pad = 0

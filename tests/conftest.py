@@ -49,6 +49,20 @@ def pytest_configure(config):
         "observed during the test fail it (see "
         "deeplearning4j_tpu/analysis/lockguard.py); DL4J_TPU_LOCKGUARD=1 "
         "applies the same check to every test in the session")
+    config.addinivalue_line(
+        "markers",
+        "shardguard: run the test with runtime sharding-drift detection — "
+        "any wrapped step dispatch whose array shardings differ from the "
+        "placed NamedShardings (implicit resharding) fails the test (see "
+        "deeplearning4j_tpu/analysis/shardguard.py); DL4J_TPU_SHARDGUARD=1 "
+        "applies the same check to every test in the session")
+    config.addinivalue_line(
+        "markers",
+        "strict_dtypes: run the test under "
+        "jax.numpy_dtype_promotion('strict') — any implicit dtype "
+        "promotion (e.g. a python float silently widening bf16 to fp32) "
+        "inside the test body fails it (parity tests must pin dtypes "
+        "explicitly, not inherit them from promotion rules)")
 
 
 @pytest.fixture(autouse=True)
@@ -88,6 +102,45 @@ def _lockguard_marker(request):
     finally:
         lg.LOCKGUARD.uninstall()
         lg.LOCKGUARD.reset()
+
+
+@pytest.fixture(autouse=True)
+def _shardguard_marker(request):
+    """Enforce the ``shardguard`` marker (or ``DL4J_TPU_SHARDGUARD=1``
+    session-wide): step dispatches through ``ShardGuard.wrap`` sites
+    (trainer sync/ZeRO steps, serving decode) are diffed against their
+    placed shardings, and any implicit resharding observed fails the
+    test at teardown.  Tests that deliberately provoke violations drive
+    their own ``ShardGuard`` instance instead of the marker."""
+    from deeplearning4j_tpu.analysis import shardguard as sg
+
+    if request.node.get_closest_marker("shardguard") is None \
+            and not sg.enabled_from_env():
+        yield
+        return
+    sg.SHARDGUARD.reset()
+    sg.SHARDGUARD.enable()
+    try:
+        yield
+        violations = sg.SHARDGUARD.violations()
+        assert not violations, sg.SHARDGUARD.report()
+    finally:
+        sg.SHARDGUARD.disable()
+        sg.SHARDGUARD.reset()
+
+
+@pytest.fixture(autouse=True)
+def _strict_dtypes_marker(request):
+    """Enforce the ``strict_dtypes`` marker: the whole test body runs
+    under ``jax.numpy_dtype_promotion("strict")``, so mixed-dtype ops
+    raise instead of silently widening (the bf16-kernel parity tests
+    must measure the kernel's arithmetic, not an accidental fp32
+    upcast)."""
+    if request.node.get_closest_marker("strict_dtypes") is None:
+        yield
+        return
+    with jax.numpy_dtype_promotion("strict"):
+        yield
 
 
 @pytest.fixture

@@ -1,0 +1,5 @@
+# Deliberately unparsable: exercises the hostile-input path — the
+# analyzer must count this file as skipped (graftlint.skipped_files)
+# and keep linting the rest of the tree, never crash.
+def broken(:
+    return oops(

@@ -2,11 +2,18 @@
 
 This is the test form of ``python -m tools.graftlint --check`` — any new
 hazard (host sync in a hot path, recompile trap, key reuse, use-after-
-donate, traced branch, uninstrumented hot loop) that is neither
-suppressed inline with a reason nor carried in the committed baseline
-fails CI here.  Companion invariants keep the baseline itself honest:
-every entry must still fire (no stale ledger lines) and carry a real
-justification (no TODOs shipped).
+donate, traced branch, uninstrumented hot loop, lock-order hazard,
+unbound collective axis, off-registry PartitionSpec axis, shard_map
+arity mismatch, donation/placement conflict, unstable reduction) that is
+neither suppressed inline with a reason nor carried in the committed
+baseline fails CI here.  Companion invariants keep the baseline itself
+honest: every entry must still fire (no stale ledger lines) and carry a
+real justification (no TODOs shipped).
+
+For the fast local pre-commit loop, run ``python -m tools.graftlint
+--diff HEAD`` instead — it lints only the ``.py`` files you changed
+(falling back to the full tree if git can't resolve the ref), then this
+test re-checks the whole package in CI with identical rule semantics.
 """
 
 import os

@@ -138,6 +138,7 @@ def test_autopick_int8_min_gate():
 
 # ---------------------------------------------------------- fused attention
 
+@pytest.mark.strict_dtypes
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_fused_attention_forward_parity(causal, dtype):
@@ -177,6 +178,7 @@ def test_fused_attention_block_sweep_and_frontier():
 
 # ------------------------------------------------------- fused ln + residual
 
+@pytest.mark.strict_dtypes
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_fused_layernorm_forward_parity_odd_rows(dtype):
     # 101 rows: prime, forces the internal pad-and-slice path
@@ -223,6 +225,7 @@ def test_fused_layernorm_batched_shape_roundtrip():
 
 # ------------------------------------------------------------- blocked xent
 
+@pytest.mark.strict_dtypes
 @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
 def test_blocked_xent_forward_parity_near_prime(dtype):
     # N=101 (prime) tokens, V=77 (odd, not a multiple of any block):

@@ -123,9 +123,12 @@ def test_data_parallel_hogwild_trains():
     assert net.evaluate(ds).f1() >= 0.85
 
 
+@pytest.mark.strict_dtypes
 def test_sync_matches_single_device_math():
     """One sync-DP step with the full batch == one single-device step on the
-    same batch (parameter averaging over equal shards ≡ full-batch gradient)."""
+    same batch (parameter averaging over equal shards ≡ full-batch gradient).
+    Runs under strict dtype promotion: the parity claim is about the same
+    arithmetic, so no implicit widening may sneak into either side."""
     net = _iris_net()
     ds = _iris_data()
     x, y = jnp.asarray(ds.features[:64]), jnp.asarray(ds.labels[:64])

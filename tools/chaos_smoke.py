@@ -9,6 +9,8 @@ recovery contract of DESIGN.md §12.  One plan runs per ZeRO stage
 reference, so sharded-state checkpoints prove the same recovery contract.
 The seed is printed in the JSON result line, so any failing draw is
 replayable with ``python tools/chaos_smoke.py --seed N [--stage K]``.
+``--shardguard`` runs every leg with runtime sharding-drift detection
+(analysis/shardguard.py) and fails on any implicit resharding.
 
 A second leg (``run_serving``) points the same dice at the serving
 subsystem: ``serving.request`` submission faults and ``serving.decode``
@@ -682,24 +684,42 @@ def run_online(seed: int) -> dict:
 
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
+    shardguard = None
+    if "--shardguard" in argv:
+        # run every leg with runtime sharding-drift detection: injected
+        # faults drive recovery paths (mesh shrink/grow, reload) that are
+        # exactly where a step can start dispatching onto stale placements
+        from deeplearning4j_tpu.analysis.shardguard import SHARDGUARD \
+            as shardguard
+        shardguard.reset()
+        shardguard.enable()
+    try:
+        return _dispatch_legs(argv, seed, shardguard)
+    finally:
+        if shardguard is not None:
+            shardguard.disable()
+
+
+def _dispatch_legs(argv: list[str], seed, shardguard) -> int:
+    def finish(result: dict) -> int:
+        if shardguard is not None:
+            result["shardguard_violations"] = len(shardguard.violations())
+            assert not shardguard.violations(), shardguard.report()
+        print(json.dumps(result))
+        return 0
+
     if "--elastic" in argv:
         # replay a single failing elastic draw
-        result = run_elastic(seed if seed is not None
-                             else random.SystemRandom().randrange(2 ** 31))
-        print(json.dumps(result))
-        return 0
+        return finish(run_elastic(seed if seed is not None
+                                  else random.SystemRandom().randrange(2 ** 31)))
     if "--online" in argv:
         # replay a single failing online-loop draw
-        result = run_online(seed if seed is not None
-                            else random.SystemRandom().randrange(2 ** 31))
-        print(json.dumps(result))
-        return 0
+        return finish(run_online(seed if seed is not None
+                                 else random.SystemRandom().randrange(2 ** 31)))
     if "--stage" in argv:
         # replay a single failing (seed, stage) draw
         stage = int(argv[argv.index("--stage") + 1])
-        result = run(seed, zero_stage=stage)
-        print(json.dumps(result))
-        return 0
+        return finish(run(seed, zero_stage=stage))
     # one random plan per ZeRO stage: recovery must restore BITWISE params
     # whether optimizer state (and, at stage 3, params) live sharded or
     # replicated — a corrupted/per-shard-mismatched restore would show up
@@ -712,8 +732,7 @@ def main(argv: list[str]) -> int:
     result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
     result["elastic"] = run_elastic(base)
     result["online"] = run_online(base)
-    print(json.dumps(result))
-    return 0
+    return finish(result)
 
 
 if __name__ == "__main__":

@@ -5,6 +5,7 @@ Usage (from the repo root):
     python -m tools.graftlint --check [PATHS...]     # CI gate: fail on NEW
     python -m tools.graftlint [PATHS...]             # report everything
     python -m tools.graftlint --json [PATHS...]      # machine-readable
+    python -m tools.graftlint --diff HEAD~1          # only git-changed files
     python -m tools.graftlint --write-baseline       # accept current state
     python -m tools.graftlint --rules                # list every rule
 
@@ -13,7 +14,10 @@ Defaults: PATHS = ``deeplearning4j_tpu``, baseline =
 any finding is neither suppressed inline (``# graftlint: disable=RULE``)
 nor carried in the baseline; it also exits 1 on unparseable files.
 ``--stale`` lists baseline entries whose finding no longer fires (fixed
-hazards whose ledger entry should be deleted).
+hazards whose ledger entry should be deleted).  ``--diff REF`` narrows
+the run to ``.py`` files changed since REF (per ``git diff``), which is
+the fast local pre-commit loop; when git is unavailable or REF is
+unknown it falls back to the full tree so CI semantics never weaken.
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -47,8 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
         description="AST-based JAX/TPU hazard analyzer (HS01 host syncs, "
                     "RC01 recompiles, RNG01 key reuse, DON01 use-after-"
                     "donate, TB01 traced branches, HOT02 uninstrumented "
-                    "hot loops, LK01-LK03/TH01 concurrency; bare --rules "
-                    "prints the full table)")
+                    "hot loops, LK01-LK03/TH01 concurrency, SH01-SH04/NM01 "
+                    "sharding + numerics; bare --rules prints the full "
+                    "table)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to analyze (default: deeplearning4j_tpu)")
     p.add_argument("--check", action="store_true",
@@ -69,12 +75,51 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", nargs="?", const="", default=None,
                    help="comma-separated rule ids to run (default: all); "
                         "bare --rules lists every registered rule and exits")
+    p.add_argument("--diff", metavar="REF", default=None,
+                   help="only lint .py files changed vs the given git ref "
+                        "(falls back to the full tree if git fails)")
     return p
+
+
+def _changed_files(ref: str, paths: list[str]) -> list[str] | None:
+    """``.py`` files changed since ``ref`` (per git, including uncommitted
+    edits), restricted to the requested ``paths``.  Returns ``None`` when
+    git is unavailable or the ref does not resolve — caller falls back to
+    the full-tree walk so ``--diff`` can only narrow, never miss."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "-z", ref, "--"],
+            cwd=_REPO_ROOT, capture_output=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    changed = [os.path.join(_REPO_ROOT, p)
+               for p in out.stdout.decode("utf-8", "replace").split("\0")
+               if p.endswith(".py")]
+    roots = [os.path.abspath(p) for p in paths]
+    kept = []
+    for f in changed:
+        af = os.path.abspath(f)
+        if any(af == r or af.startswith(r + os.sep) for r in roots):
+            kept.append(f)
+    return [f for f in kept if os.path.isfile(f)]
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     paths = args.paths or [os.path.join(_REPO_ROOT, "deeplearning4j_tpu")]
+
+    if args.diff is not None:
+        changed = _changed_files(args.diff, paths)
+        if changed is None:
+            print(f"graftlint: --diff {args.diff}: git unavailable or ref "
+                  f"unknown; falling back to full tree", file=sys.stderr)
+        elif not changed:
+            print(f"graftlint: no .py files changed vs {args.diff}")
+            return 0
+        else:
+            paths = changed
 
     if args.rules == "":          # bare --rules: print the registry
         for rid, rule in sorted(all_rules().items()):
@@ -104,13 +149,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if not args.no_metrics:
         try:
-            emit_metrics(findings)
+            emit_metrics(findings, skipped=analyzer.skipped_files)
         except Exception:
             pass  # metrics are best-effort; the lint verdict is the product
 
     new = active(findings)
     if args.as_json:
         payload = to_json(findings, errors=analyzer.errors)
+        payload["visited_files"] = analyzer.visited_files
+        payload["skipped_files"] = analyzer.skipped_files
         if args.stale:
             payload["stale_baseline_entries"] = baseline.stale_entries(findings)
         print(json.dumps(payload, indent=2))

@@ -13,6 +13,8 @@ consciously change this file too, not just watch a counter follow along.
 
 Wired as a fast tier-1 test (`tests/test_perf_smoke.py`); also runnable
 standalone: `python tools/perf_smoke.py` prints one JSON line.
+`--shardguard` runs both legs with runtime sharding-drift detection
+(analysis/shardguard.py) and fails on any implicit resharding.
 """
 
 from __future__ import annotations
@@ -181,9 +183,27 @@ def run_zero(steps: int = 30) -> dict:
     return result
 
 
-def main() -> int:
-    print(json.dumps(run()))
-    print(json.dumps(run_zero()))
+def main(argv: list[str] | None = None) -> int:
+    argv = argv or []
+    shardguard = None
+    if "--shardguard" in argv:
+        # run both legs with runtime sharding-drift detection: the ragged
+        # bucket ladder re-dispatches the same step at many shapes, which
+        # is where a drifted device_put would silently reshard per step
+        from deeplearning4j_tpu.analysis.shardguard import SHARDGUARD \
+            as shardguard
+        shardguard.reset()
+        shardguard.enable()
+    try:
+        print(json.dumps(run()))
+        print(json.dumps(run_zero()))
+        if shardguard is not None:
+            print(json.dumps(
+                {"shardguard_violations": len(shardguard.violations())}))
+            assert not shardguard.violations(), shardguard.report()
+    finally:
+        if shardguard is not None:
+            shardguard.disable()
     return 0
 
 
@@ -198,4 +218,4 @@ if __name__ == "__main__":
             os.environ.get("XLA_FLAGS", "")
             + " --xla_force_host_platform_device_count=8")
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))
