@@ -1,4 +1,4 @@
-"""The graftlint rule set — twenty-five hazard classes from this repo's
+"""The graftlint rule set — twenty-six hazard classes from this repo's
 history.
 
 | rule  | hazard                                                           |
@@ -76,6 +76,11 @@ history.
 | NM01  | hand-rolled softmax/logsumexp in `ops/`/`models/` without max    |
 |       | subtraction (`log(sum(exp))`, `exp/sum(exp)` shapes) — the       |
 |       | blocked-xent and online-softmax kernels are the sanctioned forms |
+| CT01  | raw ring/pool mutation in `control/` — the control plane must    |
+|       | scale through the `ReplicaPool`/`PrefixRouter` quarantine-drain  |
+|       | seams (`scale_up`/`scale_down`/`drain_replica`); touching a      |
+|       | `HashRing` or the pool's internals directly skips the warmed     |
+|       | gate and the drain state machine                                 |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -2142,3 +2147,85 @@ class UnstableReductionRule(Rule):
                         "exp with no max subtraction in reach — overflows "
                         "to inf on realistic logits; use jax.nn.softmax "
                         "(or the online-softmax kernels in ops/)")
+
+
+@register
+class ControlSeamRule(Rule):
+    """CT01 — raw ring/pool mutation in the control plane.
+
+    The autoscaler's correctness argument (DESIGN.md §26) rests on
+    every scale action being all-or-nothing THROUGH the serving seams:
+    ``PrefixRouter.scale_up`` gates ring admission on the warmed flag,
+    ``scale_down`` drains via the quarantine state machine and refuses
+    to detach a replica with requests in flight, and the pool publishes
+    whole rings atomically.  A control module that calls
+    ``ring.add``/``ring.remove``, builds a ``HashRing`` itself, assigns
+    ``router.ring``, or reaches into ``pool._replicas``/``pool._state``
+    re-creates exactly the failure modes those seams were built to make
+    unrepresentable: a cold replica on the ring (compile-storm TTFT), a
+    half-drained replica, or a reader observing a mid-mutation ring.
+
+    Fires, in modules under ``control/``, on: calls whose dotted target
+    ends in ``.ring.add`` / ``.ring.remove`` / ``.ring.rebuild``; any
+    ``HashRing(...)`` construction; assignment to an attribute ending
+    ``.ring``; and any read/write of a ``._replicas`` / ``._state``
+    attribute reached through another object (pool internals).
+
+    Blind spots: mutation behind an alias (``r = router.ring`` then
+    ``r.add(...)`` — only the aliasing assignment's reads escape), and
+    helpers outside ``control/`` that mutate on control's behalf (the
+    review catches those; keep actuators in serving).  Silence a
+    deliberate hit with ``# graftlint: disable=CT01`` plus the reason.
+    """
+
+    id = "CT01"
+    title = "control plane bypasses the ReplicaPool/PrefixRouter seams"
+
+    _RING_CALL_SUFFIXES = (".ring.add", ".ring.remove", ".ring.rebuild")
+    _INTERNAL_ATTRS = {"_replicas", "_state"}
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "/control/" not in path and not path.startswith("control/"):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                canon = module.canonical(node.func) \
+                    or dotted_name(node.func) or ""
+                if any(canon.endswith(s) for s in self._RING_CALL_SUFFIXES):
+                    yield self.finding(
+                        module, node,
+                        f"`{canon}` mutates a hash ring directly from the "
+                        "control plane — scale through "
+                        "`PrefixRouter.scale_up`/`scale_down` (warmed "
+                        "gate + quarantine drain + atomic ring swap)")
+                elif last_segment(canon) == "HashRing":
+                    yield self.finding(
+                        module, node,
+                        "control code builds a `HashRing` itself — ring "
+                        "construction belongs to the router's atomic "
+                        "swap; act through `PrefixRouter.scale_up`/"
+                        "`scale_down` instead")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    name = dotted_name(t) or ""
+                    if name.endswith(".ring") and "." in name:
+                        yield self.finding(
+                            module, t,
+                            f"assignment to `{name}` swaps a router's "
+                            "ring from the control plane — only the "
+                            "router publishes rings (atomically, under "
+                            "its own lock)")
+            elif isinstance(node, ast.Attribute):
+                if node.attr in self._INTERNAL_ATTRS \
+                        and isinstance(node.value, ast.Attribute):
+                    owner = dotted_name(node.value) or "<pool>"
+                    yield self.finding(
+                        module, node,
+                        f"`{owner}.{node.attr}` reaches into pool "
+                        "internals from the control plane — use the "
+                        "pool's public membership seams "
+                        "(`add_replica`/`drain_replica`/"
+                        "`remove_replica`/`inflight`)")

@@ -1,0 +1,28 @@
+"""SLO-driven control plane (DESIGN.md §26).
+
+The fleet measures everything it needs to act — burn rates, queue
+depth, forecasts — and this package closes the loop: capacity follows
+the SLO (:mod:`.autoscaler`) and overload degrades quality before it
+degrades availability (:mod:`.overload`).  Control NEVER reaches into
+serving internals: every action goes through the seams serving already
+exposes (``PrefixRouter.scale_up``/``scale_down``, the pool's
+quarantine-preserving drain, ``InferenceEngine.set_speculative``/
+``set_max_new_cap``/``set_admission_hook``, the runner's
+``register_worker``/``retire_worker``) — graftlint CT01 enforces that
+no module in here mutates a hash ring directly.
+"""
+
+from .autoscaler import Autoscaler, AutoscalerConfig, ControlSignals
+from .overload import (BrownoutConfig, BrownoutController, OverloadGate,
+                       Throttled, TokenBucketAdmission)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "ControlSignals",
+    "BrownoutConfig",
+    "BrownoutController",
+    "OverloadGate",
+    "Throttled",
+    "TokenBucketAdmission",
+]

@@ -543,6 +543,14 @@ class ForecastEvaluator:
         })
         self.warnings.append(str(path) if path else "")
 
+    def ttb_seconds(self, name: str) -> float | None:
+        """Last computed time-to-breach for objective ``name``, or None
+        before the first pass / for an unknown objective.  The ``+inf``
+        no-breach-in-sight value passes through unchanged — callers
+        (the autoscaler's ``router_signals``) compare against their own
+        horizon, and ``inf`` correctly reads as healthy there."""
+        return self.last.get(name)
+
     def status(self) -> dict[str, Any]:
         return {
             "evaluations": self.evaluations,

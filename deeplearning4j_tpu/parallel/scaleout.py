@@ -606,6 +606,44 @@ class DistributedRunner:
             "old_wave": old, "new_wave": self.n_workers, "worker": wid})
         return wid
 
+    def retire_worker(self, worker_id: str | None = None) -> str | None:
+        """Shrink the wave by ONE idle worker — the graceful inverse of
+        :meth:`register_worker` (the autoscaler's scale-in seam).
+
+        Only a worker with NO in-flight job is eligible (drain, never
+        abandon: a mid-job worker finishes and becomes eligible next
+        window), and the last enabled worker is never retired.  The
+        target ``n_workers`` drops FIRST so the respawn sweep cannot
+        refill the hole, then the worker is disabled — it parks on
+        heartbeats and takes no further jobs.  Returns the retired id,
+        or ``None`` when nothing is eligible right now (the caller
+        retries at its next control window)."""
+        live = [w for w in self.tracker.workers()
+                if self.tracker.is_enabled(w)]
+        if len(live) <= 1:
+            return None
+        if worker_id is None:
+            idle = [w for w in reversed(live)
+                    if self.tracker.job_for(w) is None]
+            worker_id = idle[0] if idle else None
+        elif (worker_id not in live
+              or self.tracker.job_for(worker_id) is not None):
+            worker_id = None
+        if worker_id is None:
+            return None
+        old = self.n_workers
+        self.n_workers = max(1, self.n_workers - 1)
+        self.tracker.disable_worker(worker_id)
+        METRICS.increment("scaleout.wave_shrinks")
+        METRICS.gauge("elastic.wave_size",
+                      len([w for w in self.tracker.workers()
+                           if self.tracker.is_enabled(w)]))
+        FLIGHTREC.dump("mesh_resize", extra={
+            "kind": "scaleout_wave", "direction": "shrink",
+            "old_wave": old, "new_wave": self.n_workers,
+            "worker": worker_id})
+        return worker_id
+
     def _shutdown_workers(self) -> None:
         self._stop.set()
         for t in self._threads:

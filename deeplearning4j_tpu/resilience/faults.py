@@ -95,6 +95,11 @@ site                   effect when armed
                        loop's rollback path — rollback retries in place
                        until the injected budget (``max_fires``) exhausts;
                        a rollback is the recovery path and MUST complete
+``control.autoscaler``  kills the autoscaler's control loop permanently
+                       (``control/autoscaler.py``, via ``FAULTS.check``) —
+                       the pool freezes at its current size (static
+                       capacity), routing and drain state untouched;
+                       ``control.autoscaler_alive`` drops to 0
 =====================  =====================================================
 
 Arming:
@@ -212,6 +217,7 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "online.publish": TransientStepFault,
     "online.reload": TransientStepFault,
     "online.rollback": TransientStepFault,
+    "control.autoscaler": TransientStepFault,
 }
 
 

@@ -57,6 +57,10 @@ class PagePoolExhausted(ServingRejected):
 
 _REQ_IDS = itertools.count(1)
 
+# priority tiers (GenerateRequest.priority / RequestQueue._tiers index)
+INTERACTIVE = 0
+BACKGROUND = 1
+
 
 @dataclasses.dataclass
 class GenerateRequest:
@@ -81,6 +85,10 @@ class GenerateRequest:
     # TenantLabels.label — NEVER a raw request string; empty when the
     # request carries no tenant or observability is off)
     tenant: str = ""
+    # priority tier: INTERACTIVE (0) or BACKGROUND (1) — background work
+    # is claimed only when no interactive request waits, preempted back
+    # into the queue at claim time, and shed first under brownout
+    priority: int = 0
 
 
 @dataclasses.dataclass
@@ -161,21 +169,84 @@ class PendingResult:
 
 
 class RequestQueue:
-    """Bounded FIFO between submitters (HTTP handler threads, direct
-    callers) and the single engine loop."""
+    """Bounded two-tier FIFO between submitters (HTTP handler threads,
+    direct callers) and the single engine loop.
 
-    def __init__(self, max_depth: int = 64, max_batch_delay_ms: float = 2.0):
+    Tier 0 (INTERACTIVE) is claimed ahead of tier 1 (BACKGROUND): a
+    background request whose claim races an interactive arrival is
+    preempted — pushed back to the head of its tier — so batch work
+    never occupies the decode slot a latency-sensitive request is
+    waiting on.  Starvation is bounded by aging: a background request
+    older than ``aging_s`` is served ahead of newer interactive
+    arrivals and cannot be preempted.
+
+    Deadline expiry removes a request the moment ANY queue operation
+    observes it dead — not only when a ``take()`` happens to pop it —
+    so ``serving.queue.depth`` counts live work.  (Before this sweep,
+    expired requests parked mid-queue inflated the gauge during
+    bursts, which is exactly the signal the autoscaler scales on.)
+    """
+
+    def __init__(self, max_depth: int = 64, max_batch_delay_ms: float = 2.0,
+                 aging_s: float = 2.0):
         self.max_depth = max_depth
         self.max_batch_delay_ms = max_batch_delay_ms
+        self.aging_s = aging_s
         self._cv = threading.Condition()
-        self._items: deque[PendingResult] = deque()
+        # index = priority tier: [INTERACTIVE, BACKGROUND]
+        self._tiers: list[deque[PendingResult]] = [deque(), deque()]
         self._woken = False              # guarded-by: self._cv
+
+    # -- locked helpers (caller holds self._cv) -------------------------
+    def _total_locked(self) -> int:
+        return len(self._tiers[INTERACTIVE]) + len(self._tiers[BACKGROUND])
+
+    def _expire_locked(self, now: float) -> None:
+        """Fail + remove every expired request in EITHER tier and
+        republish the depth gauge — deadline expiry decrements queue
+        depth at expiry, not at the next pop that reaches it."""
+        swept = False
+        for tier in self._tiers:
+            live = [p for p in tier
+                    if p.request.deadline_s is None
+                    or now <= p.request.deadline_s]
+            if len(live) == len(tier):
+                continue
+            for p in tier:
+                dl = p.request.deadline_s
+                if dl is not None and now > dl:
+                    if p._fail(DeadlineExceeded(
+                            f"request {p.request.id} expired after "
+                            f"{now - p.request.submitted_s:.3f}s in queue")):
+                        METRICS.increment("serving.deadline_dropped")
+                        TENANTS.account("deadline_dropped",
+                                        getattr(p.request, "tenant", ""))
+            tier.clear()
+            tier.extend(live)
+            swept = True
+        if swept:
+            METRICS.gauge("serving.queue.depth", self._total_locked())
+
+    def _pop_locked(self, now: float) -> PendingResult:
+        """Next request in service order: an AGED background head beats
+        everything (anti-starvation), then interactive, then background."""
+        bg = self._tiers[BACKGROUND]
+        if bg and now - bg[0].request.submitted_s >= self.aging_s:
+            return bg.popleft()
+        inter = self._tiers[INTERACTIVE]
+        if inter:
+            return inter.popleft()
+        return bg.popleft()
 
     def submit(self, request) -> PendingResult:
         """Enqueue or reject — never blocks the submitter."""
         FAULTS.maybe_fire("serving.request")
         with self._cv:
-            if len(self._items) >= self.max_depth:
+            now = time.monotonic()
+            # sweep first: during a burst, expired requests must free
+            # their capacity for live ones instead of forcing a 429
+            self._expire_locked(now)
+            if self._total_locked() >= self.max_depth:
                 METRICS.increment("serving.rejected")
                 # ScoreRequest carries no tenant field; getattr keeps the
                 # score path free of the attribute
@@ -184,11 +255,13 @@ class RequestQueue:
                 raise QueueFull(
                     f"request queue full ({self.max_depth} deep) — retry "
                     "with backoff")
-            request.submitted_s = time.monotonic()
+            request.submitted_s = now
             request.submitted_perf = time.perf_counter()
             pending = PendingResult(request)
-            self._items.append(pending)
-            METRICS.gauge("serving.queue.depth", len(self._items))
+            tier = BACKGROUND if getattr(request, "priority", 0) > 0 \
+                else INTERACTIVE
+            self._tiers[tier].append(pending)
+            METRICS.gauge("serving.queue.depth", self._total_locked())
             self._cv.notify()
         return pending
 
@@ -209,43 +282,36 @@ class RequestQueue:
             return []
         out: list[PendingResult] = []
         with self._cv:
-            if not self._items and block_s > 0:
+            if not self._total_locked() and block_s > 0:
                 # loop: condition waits wake spuriously and on unrelated
                 # notifies — re-check the predicate until the deadline;
                 # an explicit wake() (engine shutdown, slot freed) breaks
                 # out immediately instead of riding out the timeout
                 end = time.monotonic() + block_s
-                while not self._items and not self._woken:
+                while not self._total_locked() and not self._woken:
                     left = end - time.monotonic()
                     if left <= 0 or not self._cv.wait(left):
                         break
             self._woken = False
-            if self._items and block_s > 0 and len(self._items) < max_n \
+            if self._total_locked() and block_s > 0 \
+                    and self._total_locked() < max_n \
                     and self.max_batch_delay_ms > 0:
                 end = time.monotonic() + self.max_batch_delay_ms / 1000.0
-                while len(self._items) < max_n:
+                while self._total_locked() < max_n:
                     left = end - time.monotonic()
                     if left <= 0 or not self._cv.wait(left):
                         break
             now = time.monotonic()
-            while self._items and len(out) < max_n:
-                p = self._items.popleft()
-                dl = p.request.deadline_s
-                if dl is not None and now > dl:
-                    if p._fail(DeadlineExceeded(
-                            f"request {p.request.id} expired after "
-                            f"{now - p.request.submitted_s:.3f}s in queue")):
-                        METRICS.increment("serving.deadline_dropped")
-                        TENANTS.account("deadline_dropped",
-                                        getattr(p.request, "tenant", ""))
-                    continue
+            self._expire_locked(now)
+            while self._total_locked() and len(out) < max_n:
+                p = self._pop_locked(now)
                 METRICS.observe_time("serving.queue_wait",
                                      now - p.request.submitted_s)
                 TENANTS.account("queue_wait_s",
                                 getattr(p.request, "tenant", ""),
                                 now - p.request.submitted_s)
                 out.append(p)
-            METRICS.gauge("serving.queue.depth", len(self._items))
+            METRICS.gauge("serving.queue.depth", self._total_locked())
         return out
 
     def claim(self, p: PendingResult) -> bool:
@@ -258,6 +324,13 @@ class RequestQueue:
         queue lock the request either expires here (completes with
         :class:`DeadlineExceeded`, never decodes) or is admitted — after
         a True claim the deadline no longer applies to admission.
+
+        Claim time is ALSO the preemption point: a background request
+        whose slot an interactive arrival now wants is pushed back to
+        the head of its tier (still pending, re-taken later) and the
+        claim returns False — the same "False means skip, not fail"
+        contract the engine already honours for expiry races.  An aged
+        background request is exempt, so preemption cannot starve.
         """
         with self._cv:
             if p.done():
@@ -273,6 +346,14 @@ class RequestQueue:
                     TENANTS.account("deadline_dropped",
                                     getattr(p.request, "tenant", ""))
                 return False
+            if (getattr(p.request, "priority", 0) > 0
+                    and self._tiers[INTERACTIVE]
+                    and now - p.request.submitted_s < self.aging_s):
+                self._tiers[BACKGROUND].appendleft(p)
+                METRICS.increment("serving.preempted")
+                METRICS.gauge("serving.queue.depth", self._total_locked())
+                self._cv.notify()
+                return False
             return True
 
     def wake(self) -> None:
@@ -286,13 +367,19 @@ class RequestQueue:
             self._cv.notify_all()
 
     def depth(self) -> int:
+        """Live queued requests — expired ones are swept (and their
+        depth-gauge contribution dropped) before counting, so the
+        autoscaler's primary signal never includes dead work."""
         with self._cv:
-            return len(self._items)
+            self._expire_locked(time.monotonic())
+            return self._total_locked()
 
     def drain(self) -> list[PendingResult]:
         """Remove and return everything queued (engine shutdown path)."""
         with self._cv:
-            out = list(self._items)
-            self._items.clear()
+            out = list(self._tiers[INTERACTIVE]) \
+                + list(self._tiers[BACKGROUND])
+            for tier in self._tiers:
+                tier.clear()
             METRICS.gauge("serving.queue.depth", 0)
         return out

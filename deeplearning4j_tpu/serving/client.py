@@ -91,6 +91,7 @@ class ServingClient:
                  eos_id: int | None = None,
                  deadline_ms: float | None = None,
                  tenant: str | None = None,
+                 priority: int = 0,
                  timeout_s: float | None = None) -> dict:
         body = {"prompt": list(prompt), "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "seed": seed}
@@ -100,6 +101,8 @@ class ServingClient:
             body["deadline_ms"] = deadline_ms
         if tenant:
             body["tenant"] = tenant
+        if priority:
+            body["priority"] = int(priority)
         return self._json("/v1/generate", body, timeout_s=timeout_s)
 
     def score(self, inputs) -> list:

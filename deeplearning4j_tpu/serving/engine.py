@@ -247,7 +247,20 @@ class InferenceEngine:
             jax.jit(
                 self._build_step(),
                 donate_argnums=(2,) if cfg.speculative else (1,)))
+        # brownout seam (DESIGN.md §26): a speculative engine also carries
+        # the PLAIN step, compiled at warmup alongside the spec one, so
+        # ladder level 1 (disable speculation) swaps dispatch at a fence
+        # with no compile stall and no parity change — the draft only ever
+        # decided how many tokens emit per dispatch, never which
+        self._spec_enabled = cfg.speculative         # guarded-by: self._lock
+        self._plain_step_fn = (SHARDGUARD.wrap(
+            "serving.decode_step_plain",
+            jax.jit(self._build_plain_step(), donate_argnums=(1,)))
+            if cfg.speculative else None)
+        self._max_new_cap: int | None = None         # guarded-by: self._lock
+        self._admission_hook = None                  # guarded-by: self._lock
         self._step_compiled = False
+        self._warmed = False   # True once warmup() finished (healthz gate)
         self._admit_fns: dict[int, Callable] = {}    # guarded-by: self._lock
         self._slots: dict[int, _Slot] = {}           # guarded-by: self._lock
         self._slot_pages: dict[int, list[int]] = {}  # guarded-by: self._lock
@@ -321,6 +334,9 @@ class InferenceEngine:
     def _build_step(self) -> Callable:
         if self.cfg.speculative:
             return self._build_spec_step()
+        return self._build_plain_step()
+
+    def _build_plain_step(self) -> Callable:
         cfg = self.model.cfg
         paged = self.cfg.paged
         attn_fn = self._paged_attn_fn() if paged else None
@@ -585,14 +601,16 @@ class InferenceEngine:
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                seed: int = 0, eos_id: int | None = None,
                deadline_ms: float | None = None,
-               tenant: str = "") -> PendingResult:
+               tenant: str = "", priority: int = 0) -> PendingResult:
         """Validate + enqueue; returns a handle whose ``result()`` blocks.
         Raises ``ValueError`` on malformed requests (HTTP 400) and
         :class:`~.batcher.QueueFull` under backpressure (HTTP 429).
         ``tenant`` is an opaque caller identity for per-tenant accounting;
         it is folded ONCE here through the bounded label helper and the
         folded label rides the request — downstream metric sites never
-        see the raw string (graftlint OB03)."""
+        see the raw string (graftlint OB03).  ``priority`` > 0 marks
+        BACKGROUND work: claimed only when no interactive request waits
+        (aging prevents starvation) and shed first under brownout."""
         cfg = self.model.cfg
         prompt = [int(t) for t in prompt]
         if not prompt:
@@ -605,13 +623,28 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds max_len ({cfg.max_len})")
+        with self._lock:
+            cap = self._max_new_cap
+            hook = self._admission_hook
+        if cap is not None and max_new_tokens > cap:
+            # brownout level 2: serve a SHORTER completion instead of
+            # shedding — the served tokens are exactly the offline
+            # sample's prefix under the clamped budget, so token parity
+            # holds for everything that is served
+            max_new_tokens = cap
+            METRICS.increment("serving.max_new_clamped")
         req = GenerateRequest(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), seed=int(seed),
             eos_id=eos_id if eos_id is not None else self.cfg.default_eos_id,
             deadline_s=(time.monotonic() + deadline_ms / 1000.0
                         if deadline_ms else None),
-            tenant=TENANTS.label(str(tenant)) if tenant else "")
+            tenant=TENANTS.label(str(tenant)) if tenant else "",
+            priority=1 if int(priority) > 0 else 0)
+        if hook is not None:
+            # admission-side overload gate (control/overload.py): raises
+            # a ServingRejected subclass — throttle/shed IS the API (429)
+            hook(req)
         if _obs_enabled():
             # trace identity for the whole request: adopt the caller's
             # context (HTTP traceparent installed via trace.bind, or an
@@ -751,6 +784,10 @@ class InferenceEngine:
                         self._params, dparams, self._state, jnp.int32(0))
                     state, _ = self._step_fn(self._params, dparams,
                                              self._state, jnp.int32(0))
+                    # the brownout fallback step compiles NOW, not at the
+                    # moment the ladder disables speculation — degrading
+                    # under load must never pay a compile stall
+                    state, _ = self._plain_step_fn(self._params, state)
                 else:
                     self._decode_cost = COSTS.capture(
                         "serving.decode_step", self._step_fn,
@@ -779,6 +816,11 @@ class InferenceEngine:
                     self._state = dict(
                         self._state,
                         bt=self._state["bt"].at[0].set(self._num_pages))
+        # the warmed flag flips only after the step fn(s) AND the full
+        # prefill bucket ladder compiled — the signal the router's
+        # scale-up path gates ring admission on (a cold replica on the
+        # ring is a compile-storm TTFT spike for the keys it inherits)
+        self._warmed = True
 
     def _wipe_pages(self, freed: list[int]) -> None:
         """Zero physical pages whose refcount just hit zero (never an
@@ -1019,10 +1061,15 @@ class InferenceEngine:
         """Dispatch ``resolve_every`` decode steps with NO host syncs —
         the emitted-token arrays stay on device until ``_resolve``."""
         out = []
-        step_fn = self._step_fn
         with self._lock:
             params = self._params
-        spec = self.cfg.speculative
+            spec_on = self._spec_enabled
+        # brownout level 1 applies HERE, at segment granularity: every
+        # dispatch in a segment runs one path, and the swap happens at a
+        # fence — in-flight slots keep exact token parity either way
+        spec = self.cfg.speculative and spec_on
+        step_fn = self._step_fn if spec or not self.cfg.speculative \
+            else self._plain_step_fn
         dparams = self._draft_params if spec else None
         for _ in range(self.cfg.resolve_every):
             if FAULTS.check("serving.decode") is not None:
@@ -1246,6 +1293,43 @@ class InferenceEngine:
         if step is not None:
             METRICS.gauge("serving.loaded_step", step)
 
+    # ------------------------------------------------- brownout actuators
+    def set_speculative(self, enabled: bool) -> bool:
+        """Brownout ladder level 1: turn speculative decoding off (or
+        back on) at runtime.  Returns the new effective state.  Safe at
+        any moment: the switch is read once per decode SEGMENT (a device
+        fence), and the draft model only ever decided how many target
+        tokens emit per dispatch — never which — so served tokens keep
+        exact parity either way.  No-op on a plain engine."""
+        if not self.cfg.speculative:
+            return False
+        with self._lock:
+            self._spec_enabled = bool(enabled)
+            now = self._spec_enabled
+        METRICS.gauge("serving.speculative_enabled", 1.0 if now else 0.0)
+        return now
+
+    def set_max_new_cap(self, cap: int | None) -> None:
+        """Brownout ladder level 2: clamp every future request's
+        ``max_new_tokens`` to ``cap`` at admission (``None`` lifts the
+        clamp).  In-flight requests keep their admitted budget."""
+        if cap is not None and int(cap) < 1:
+            raise ValueError(f"max_new cap must be >= 1, got {cap}")
+        with self._lock:
+            self._max_new_cap = int(cap) if cap is not None else None
+        METRICS.gauge("serving.max_new_cap",
+                      float(cap) if cap is not None else 0.0)
+
+    def set_admission_hook(self, hook) -> None:
+        """Install (or clear, with ``None``) an admission-side gate
+        called with each validated :class:`GenerateRequest` BEFORE it
+        enters the queue.  The hook rejects by raising a
+        :class:`~.batcher.ServingRejected` subclass — the seam
+        ``control/overload.py`` uses for per-tenant throttling and
+        brownout shedding without serving importing control."""
+        with self._lock:
+            self._admission_hook = hook
+
     # ------------------------------------------------------------ stats
     def stats(self) -> dict:
         with self._lock:
@@ -1261,6 +1345,10 @@ class InferenceEngine:
                 "reload_staged": self._staged is not None,
                 "prefill_buckets": sorted(self._admit_fns),
                 "running": self._thread is not None,
+                "warmed": self._warmed,
+                "speculative_enabled": (self.cfg.speculative
+                                        and self._spec_enabled),
+                "max_new_cap": self._max_new_cap,
             }
         if self._pool is not None:
             out["kv_pages"] = self._num_pages

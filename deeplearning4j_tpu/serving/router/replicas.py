@@ -111,6 +111,7 @@ class EngineReplica(Replica):
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None,
             tenant=str(payload.get("tenant") or ""),
+            priority=int(payload.get("priority", 0)),
             timeout=timeout_s)
         return {"tokens": comp.tokens, "finish_reason": comp.finish_reason,
                 "latency_s": comp.latency_s, "ttft_s": comp.ttft_s}
@@ -213,6 +214,7 @@ class ProcessReplica(Replica):
                 eos_id=payload.get("eos_id"),
                 deadline_ms=payload.get("deadline_ms"),
                 tenant=payload.get("tenant"),
+                priority=int(payload.get("priority", 0) or 0),
                 timeout_s=timeout_s)
         except OSError as e:
             # connection refused/reset or socket timeout: the child is
@@ -266,6 +268,7 @@ class ProcessReplica(Replica):
 
 ACTIVE = "active"
 QUARANTINED = "quarantined"
+DRAINING = "draining"   # administrative quarantine: scale-in in progress
 
 
 class _ReplicaState:
@@ -323,7 +326,8 @@ class ReplicaPool:
 
     def is_active(self, name: str) -> bool:
         with self._lock:
-            return self._state[name].state == ACTIVE
+            st = self._state.get(name)
+            return st is not None and st.state == ACTIVE
 
     def active_names(self) -> list[str]:
         with self._lock:
@@ -333,12 +337,97 @@ class ReplicaPool:
         with self._lock:
             return dict(self._state[name].last_probe)
 
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            st = self._state.get(name)
+            return st.inflight if st is not None else 0
+
+    # --------------------------------------------------- elastic membership
+    #
+    # The scale seams (DESIGN.md §26).  ``_replicas`` is mutated copy-on-
+    # write under ``_lock`` — dispatch and the prober read it LOCKLESS, so
+    # they must always see a complete dict, never a half-mutated one.
+    # Scale-in reuses the quarantine state machine: ``drain_replica`` parks
+    # the replica in DRAINING (``is_active`` false — routing drains its
+    # keys to the clockwise ring successors exactly as a breaker trip
+    # would, and probes can never re-admit it), and ``remove_replica``
+    # refuses until the drain finished (zero in flight) — a half-drained
+    # replica is unrepresentable.
+
+    def add_replica(self, replica: Replica) -> None:
+        """Admit a NEW replica into the pool (ACTIVE).  The caller is
+        responsible for warming it first — see ``PrefixRouter.scale_up``,
+        which gates ring admission on the replica's warmed health flag."""
+        with self._lock:
+            if replica.name in self._replicas:
+                raise ValueError(f"replica {replica.name!r} already pooled")
+            self._replicas = {**self._replicas, replica.name: replica}
+            self._state[replica.name] = _ReplicaState()
+        METRICS.increment("router.replicas_added")
+        METRICS.gauge(f"router.replica_state.{replica.name}", 1.0)
+
+    def drain_replica(self, name: str) -> None:
+        """Begin scale-in: stop routing to ``name`` (quarantine-path
+        semantics — its ring segment drains to clockwise successors) while
+        in-flight requests finish.  Idempotent."""
+        with self._lock:
+            st = self._state[name]
+            already = st.state == DRAINING
+            st.state = DRAINING
+            inflight = st.inflight
+        if already:
+            return
+        METRICS.increment("router.drains")
+        METRICS.gauge(f"router.replica_state.{name}", 0.0)
+        from ...observability import FLIGHTREC
+        FLIGHTREC.dump("router_replica_drain",
+                       extra={"replica": name, "inflight": inflight})
+
+    def reactivate_replica(self, name: str) -> None:
+        """Abort a drain (scale-in timed out or was cancelled): the
+        replica returns to ACTIVE and its ring segment snaps back to the
+        original assignment — fail safe is *more* capacity, never a
+        half-drained replica."""
+        with self._lock:
+            st = self._state[name]
+            if st.state != DRAINING:
+                return
+            st.state = ACTIVE
+            st.consecutive_failures = 0
+        METRICS.increment("router.drain_aborts")
+        METRICS.gauge(f"router.replica_state.{name}", 1.0)
+
+    def remove_replica(self, name: str) -> Replica:
+        """Complete scale-in: detach a fully drained replica and return
+        it (the caller owns ``close()``).  Refuses while the replica is
+        still ACTIVE or has requests in flight."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                raise KeyError(name)
+            if st.state == ACTIVE:
+                raise RuntimeError(
+                    f"replica {name!r} is ACTIVE — drain_replica() first")
+            if st.inflight:
+                raise RuntimeError(
+                    f"replica {name!r} still has {st.inflight} request(s) "
+                    "in flight — drain must finish before removal")
+            replicas = dict(self._replicas)
+            rep = replicas.pop(name)
+            self._replicas = replicas
+            del self._state[name]
+        METRICS.increment("router.replicas_removed")
+        METRICS.gauge(f"router.replica_state.{name}", 0.0)
+        return rep
+
     # ------------------------------------------------------------ breaker
     def record_failure(self, name: str, reason: str) -> bool:
         """One failed probe or dispatch; returns True when this failure
         tripped the breaker (ACTIVE -> QUARANTINED)."""
         with self._lock:
-            st = self._state[name]
+            st = self._state.get(name)
+            if st is None:
+                return False   # removed (scale-in) while a probe ran
             st.consecutive_successes = 0
             st.consecutive_failures += 1
             tripped = (st.state == ACTIVE
@@ -364,7 +453,9 @@ class ReplicaPool:
         """One successful probe or dispatch; returns True when it
         re-admitted a quarantined replica."""
         with self._lock:
-            st = self._state[name]
+            st = self._state.get(name)
+            if st is None:
+                return False   # removed (scale-in) while a probe ran
             st.consecutive_failures = 0
             st.consecutive_successes += 1
             if probe is not None:
@@ -388,8 +479,11 @@ class ReplicaPool:
 
     def end_request(self, name: str) -> None:
         with self._lock:
-            self._state[name].inflight -= 1
-            load = self._state[name].inflight
+            st = self._state.get(name)
+            if st is None:
+                return
+            st.inflight -= 1
+            load = st.inflight
         METRICS.gauge(f"router.replica_load.{name}", float(load))
 
     # ------------------------------------------------------------ probing
@@ -398,7 +492,9 @@ class ReplicaPool:
         breaker state advanced, aggregate gauges published."""
         total_hits = total_lookups = 0
         have_prefix = False
-        for name, rep in self._replicas.items():
+        # membership is copy-on-write: this grabs one consistent snapshot,
+        # so a concurrent scale-up/scale-in can never break the sweep
+        for name, rep in list(self._replicas.items()):
             try:
                 if replica_down(name):
                     raise ReplicaUnavailable(

@@ -34,6 +34,8 @@ decode/emit spans (cross-process via the ``traceparent`` header the
 from __future__ import annotations
 
 import hashlib
+import threading
+import time
 from dataclasses import dataclass
 
 from ...observability import METRICS, trace
@@ -74,6 +76,12 @@ class PrefixRouter:
             probe_timeout_s=cfg.probe_timeout_s,
             fail_threshold=cfg.fail_threshold,
             recover_threshold=cfg.recover_threshold)
+        # the ring is immutable once published: elastic membership swaps a
+        # freshly built ring ATOMICALLY (one attribute store) under
+        # _ring_lock, exactly the future the HashRing docstring reserves —
+        # lookups stay lockless, a reader sees the old ring or the new
+        # one, never a half-mutated one
+        self._ring_lock = threading.Lock()
         self.ring = HashRing(self.pool.names(), vnodes=cfg.vnodes)
 
     # ------------------------------------------------------------ routing
@@ -100,6 +108,7 @@ class PrefixRouter:
                  eos_id: int | None = None,
                  deadline_ms: float | None = None,
                  tenant: str | None = None,
+                 priority: int = 0,
                  timeout_s: float | None = None) -> dict:
         """Route one generation; returns the replica's completion dict
         plus ``replica`` (who served it) and ``spills`` (how many nodes
@@ -114,6 +123,8 @@ class PrefixRouter:
             payload["deadline_ms"] = deadline_ms
         if tenant:
             payload["tenant"] = str(tenant)
+        if priority:
+            payload["priority"] = int(priority)
         timeout = timeout_s if timeout_s is not None \
             else self.cfg.request_timeout_s
         key = self.routing_key(prompt)
@@ -127,7 +138,10 @@ class PrefixRouter:
                 order = order[: self.cfg.max_spill + 1]
             last_rejection: ServingRejected | None = None
             for spills, name in enumerate(order):
-                rep = self.pool.replica(name)
+                try:
+                    rep = self.pool.replica(name)
+                except KeyError:
+                    continue   # removed (scale-in) after route_order ran
                 self.pool.begin_request(name)
                 try:
                     with trace.span("router.route", replica=name,
@@ -175,6 +189,74 @@ class PrefixRouter:
                 return out
             raise last_rejection if last_rejection is not None else \
                 AllReplicasUnavailable("all replicas failed")
+
+    # ------------------------------------------------------ elastic scale
+    def scale_up(self, replica: Replica, warm_timeout_s: float = 120.0,
+                 poll_s: float = 0.05) -> None:
+        """Admit a freshly built replica: wait for its engine to report
+        ``warmed`` over ``/healthz``, THEN add it to the pool and publish
+        a new ring.  The warm gate is the whole point — a cold replica on
+        the ring inherits its keyspace segment immediately and every
+        request it receives pays a compile stall (the scale-up
+        TTFT-spike regression this ordering fixes).  On warm timeout the
+        replica is NOT admitted (and is closed): fail safe is the old
+        capacity, never a cold ring node."""
+        try:
+            self._await_warm(replica, warm_timeout_s, poll_s)
+        except Exception:
+            replica.close()
+            raise
+        self.pool.add_replica(replica)
+        with self._ring_lock:
+            self.ring = HashRing(self.pool.names(), vnodes=self.cfg.vnodes)
+        METRICS.increment("router.scale_up")
+        METRICS.gauge("router.pool_size", float(len(self.pool.names())))
+
+    def scale_down(self, name: str, drain_timeout_s: float = 30.0,
+                   poll_s: float = 0.02) -> Replica:
+        """Drain-and-remove ``name``: quarantine-path drain first (its
+        ring segment spills to the clockwise successors while in-flight
+        requests finish), then detach and publish a ring without it.
+        Returns the detached replica — the caller owns ``close()``.  On
+        drain timeout the replica is REACTIVATED and the call raises:
+        the pool can end up bigger than intended, never half-drained."""
+        if len(self.pool.names()) <= 1:
+            raise RuntimeError("refusing to scale down the last replica")
+        self.pool.drain_replica(name)
+        deadline = time.monotonic() + drain_timeout_s
+        while self.pool.inflight(name) > 0:
+            if time.monotonic() > deadline:
+                self.pool.reactivate_replica(name)
+                raise TimeoutError(
+                    f"replica {name!r} did not drain within "
+                    f"{drain_timeout_s}s — reactivated (fail safe)")
+            time.sleep(poll_s)
+        rep = self.pool.remove_replica(name)
+        with self._ring_lock:
+            self.ring = HashRing(self.pool.names(), vnodes=self.cfg.vnodes)
+        METRICS.increment("router.scale_down")
+        METRICS.gauge("router.pool_size", float(len(self.pool.names())))
+        return rep
+
+    @staticmethod
+    def _await_warm(replica: Replica, timeout_s: float,
+                    poll_s: float) -> None:
+        """Block until the replica's health answer carries a truthy
+        engine ``warmed`` flag (set at the END of ``warmup()`` — step fn
+        plus the full prefill bucket ladder compiled)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                health = replica.healthz(min(timeout_s, 5.0))
+                if bool((health.get("engine") or {}).get("warmed")):
+                    return
+            except (ServingRejected, ServingError, OSError):
+                pass   # still booting — keep polling until the deadline
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replica {replica.name!r} not warmed within "
+                    f"{timeout_s}s — refusing ring admission")
+            time.sleep(poll_s)
 
     # ------------------------------------------------------------ admin
     def reload(self, step: int | None = None) -> dict[str, int]:

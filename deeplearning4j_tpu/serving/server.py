@@ -7,7 +7,7 @@ transport, not a new framework:
 
 - ``POST /v1/generate``  — continuous-batching decode; body
   ``{"prompt": [ids], "max_new_tokens", "temperature", "seed", "eos_id",
-  "deadline_ms", "tenant"}`` → ``{"tokens", "finish_reason", "latency_s",
+  "deadline_ms", "tenant", "priority"}`` → ``{"tokens", "finish_reason", "latency_s",
   "ttft_s"}`` (``tenant`` is an opaque caller identity: it lands on the
   capture record raw and on metrics through the bounded label fold)
 - ``POST /v1/score``     — batched forward; ``{"inputs": [[...], ...]}``
@@ -138,6 +138,7 @@ class ModelServer:
             eos_id=int(eos) if eos is not None else None,
             deadline_ms=float(dl) if dl is not None else None,
             tenant=tenant,
+            priority=int(p.get("priority", 0)),
             timeout=self.request_timeout_s)
         if self.capture is not None:
             # after completion only — rejected/expired requests never

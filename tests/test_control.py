@@ -1,0 +1,562 @@
+"""Control-plane tests (DESIGN.md §26): autoscaler decisions against a
+scripted metric feed, brownout ladder hysteresis, per-tenant fair share,
+priority tiers with aging, the queue-depth-at-expiry fix, and the
+router's warmed-gated ring admission.
+
+Every decision test drives the controller with an injected clock and
+hand-written :class:`ControlSignals` — no threads, no sleeps, no real
+engine — which is exactly what the four-callable wiring exists for.
+"""
+
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.control import (Autoscaler, AutoscalerConfig,
+                                        BrownoutConfig, BrownoutController,
+                                        ControlSignals, OverloadGate,
+                                        Throttled, TokenBucketAdmission)
+from deeplearning4j_tpu.control.overload import BucketConfig
+from deeplearning4j_tpu.observability import METRICS
+from deeplearning4j_tpu.resilience.faults import FaultSpec, inject_faults
+from deeplearning4j_tpu.serving import RequestQueue
+from deeplearning4j_tpu.serving.batcher import (DeadlineExceeded,
+                                                GenerateRequest)
+from deeplearning4j_tpu.serving.router.replicas import Replica
+from deeplearning4j_tpu.serving.router.router import (PrefixRouter,
+                                                      RouterConfig)
+
+
+# ------------------------------------------------------------------ harness
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class _Harness:
+    """Scripted-feed autoscaler: a list of ControlSignals plays back one
+    per step(); actuators mutate a fake pool size and log calls."""
+
+    def __init__(self, size=1, **cfg_kw):
+        cfg_kw.setdefault("interval_s", 0.01)
+        cfg_kw.setdefault("cooldown_s", 5.0)
+        cfg_kw.setdefault("down_consecutive", 3)
+        self.cfg = AutoscalerConfig(**cfg_kw)
+        self.clock = _Clock()
+        self.size = size
+        self.feed = []
+        self.actions = []
+        self.fail_next = None
+        self.scaler = Autoscaler(
+            self._read, self._up, self._down, lambda: self.size,
+            cfg=self.cfg, clock=self.clock)
+
+    def _read(self):
+        return self.feed.pop(0) if self.feed else ControlSignals()
+
+    def _up(self):
+        if self.fail_next == "up":
+            self.fail_next = None
+            raise RuntimeError("actuator broke")
+        self.size += 1
+        self.actions.append("up")
+
+    def _down(self):
+        if self.fail_next == "down":
+            self.fail_next = None
+            raise RuntimeError("actuator broke")
+        self.size -= 1
+        self.actions.append("down")
+
+    def play(self, sig, dt=1.0):
+        self.clock.tick(dt)
+        self.feed.append(sig)
+        return self.scaler.step()
+
+
+BURNING = ControlSignals(burn=2.0)
+QUIET = ControlSignals(burn=0.1, queue_depth=0)
+
+
+# ------------------------------------------------------------- decisions
+def test_scale_up_on_burn():
+    h = _Harness()
+    assert h.play(BURNING) == "up"
+    assert h.size == 2
+    assert METRICS.snapshot()["counters"]["control.scale_up"] == 1
+
+
+def test_scale_up_on_queue_depth_and_forecast():
+    h = _Harness(queue_high=10)
+    assert h.play(ControlSignals(burn=0.0, queue_depth=50)) == "up"
+    h2 = _Harness(ttb_horizon_s=60.0)
+    assert h2.play(ControlSignals(burn=0.0, ttb_s=30.0)) == "up"
+    # a receding forecast is not pressure
+    h3 = _Harness(ttb_horizon_s=60.0)
+    assert h3.play(ControlSignals(burn=0.0, ttb_s=10_000.0)) is None
+
+
+def test_cooldown_one_action_per_window():
+    h = _Harness()
+    assert h.play(BURNING) == "up"
+    # still burning, but inside the cooldown: no second action
+    assert h.play(BURNING, dt=1.0) is None
+    assert h.play(BURNING, dt=1.0) is None
+    # cooldown over -> the next burning window acts again
+    assert h.play(BURNING, dt=10.0) == "up"
+    assert h.actions == ["up", "up"]
+
+
+def test_scale_down_needs_consecutive_quiet_windows():
+    h = _Harness(size=3)
+    assert h.play(QUIET, dt=10.0) is None     # quiet #1
+    assert h.play(QUIET) is None              # quiet #2
+    assert h.play(QUIET) == "down"            # quiet #3 -> act
+    assert h.size == 2
+    assert METRICS.snapshot()["counters"]["control.scale_down"] == 1
+
+
+def test_hysteresis_blocks_flapping():
+    h = _Harness(size=2)
+    # a pressure window resets the quiet vote even while cooldown
+    # blocks acting on it — alternating load must produce NO actions
+    for _ in range(6):
+        assert h.play(QUIET, dt=10.0) is None
+        assert h.play(BURNING, dt=10.0) == "up" or True
+    # the ups are legitimate (each after a full cooldown); the point is
+    # zero downs ever happened between them
+    assert "down" not in h.actions
+
+
+def test_bounds_clamp():
+    h = _Harness(size=4, max_replicas=4, min_replicas=1)
+    assert h.play(BURNING) is None            # at max: no up
+    h2 = _Harness(size=1, min_replicas=1)
+    for _ in range(5):
+        assert h2.play(QUIET, dt=10.0) is None   # at min: no down
+    assert h2.actions == []
+
+
+def test_failed_actuator_burns_the_cooldown_window():
+    h = _Harness()
+    h.fail_next = "up"
+    assert h.play(BURNING) == "up"            # decision made...
+    assert h.size == 1                        # ...but the actuator failed
+    assert METRICS.snapshot()["counters"]["control.scale_errors"] == 1
+    # the failed attempt still holds the cooldown — no retry storm
+    assert h.play(BURNING, dt=1.0) is None
+    assert h.play(BURNING, dt=10.0) == "up"
+    assert h.size == 2
+
+
+def test_killed_autoscaler_degrades_to_static_capacity():
+    h = _Harness()
+    assert h.play(BURNING) == "up"
+    with inject_faults(FaultSpec("control.autoscaler", probability=1.0)):
+        assert h.play(BURNING, dt=10.0) is None
+    assert h.scaler.dead
+    snap = METRICS.snapshot()
+    assert snap["counters"]["control.autoscaler_killed"] == 1
+    assert snap["gauges"]["control.autoscaler_alive"] == 0.0
+    # dead means STATIC: burning signals no longer reach the actuators
+    for _ in range(3):
+        assert h.play(BURNING, dt=10.0) is None
+    assert h.size == 2 and h.actions == ["up"]
+    # and a dead controller refuses to restart into a zombie loop
+    assert h.scaler.start() is False
+
+
+def test_daemon_lifecycle():
+    h = _Harness()
+    assert h.scaler.start() is True
+    assert h.scaler.start() is False          # no-op while alive
+    assert h.scaler.running
+    h.scaler.stop()
+    assert not h.scaler.running
+
+
+# -------------------------------------------------------------- brownout
+class _FakeEngine:
+    def __init__(self):
+        self.spec = True
+        self.cap = None
+
+    def set_speculative(self, enabled):
+        self.spec = bool(enabled)
+        return self.spec
+
+    def set_max_new_cap(self, cap):
+        self.cap = cap
+
+
+def test_brownout_ladder_and_hysteresis():
+    clock = _Clock()
+    eng = _FakeEngine()
+    bc = BrownoutController(eng, BrownoutConfig(
+        enter_burn=(1.0, 2.0, 4.0), exit_fraction=0.5, dwell_s=1.0,
+        clamp_max_new=8), clock=clock)
+    clock.tick(10)
+    assert bc.update(0.5) == 0 and eng.spec and eng.cap is None
+    assert bc.update(1.2) == 1                # level 1: spec off
+    assert not eng.spec and eng.cap is None
+    clock.tick(2)
+    assert bc.update(2.5) == 2 and eng.cap == 8
+    clock.tick(2)
+    assert bc.update(9.0) == 3
+    assert bc.shed_background
+    # exit hysteresis: burn must drop BELOW exit_fraction * enter rung,
+    # and only one rung per dwell — no cliff exits
+    clock.tick(2)
+    assert bc.update(3.0) == 3                # 3.0 >= 4.0*0.5: hold
+    clock.tick(2)
+    assert bc.update(1.5) == 2
+    clock.tick(2)
+    assert bc.update(0.4) == 1
+    clock.tick(2)
+    assert bc.update(0.4) == 0
+    assert eng.spec and eng.cap is None       # fully restored
+    snap = METRICS.snapshot()
+    assert snap["gauges"]["control.brownout_level"] == 0.0
+    assert snap["counters"]["control.brownout_transitions"] == 6
+
+
+def test_brownout_dwell_and_missing_signal_hold_level():
+    clock = _Clock()
+    bc = BrownoutController(None, BrownoutConfig(dwell_s=5.0), clock=clock)
+    clock.tick(10)
+    assert bc.update(2.5) == 2
+    clock.tick(1)
+    assert bc.update(0.0) == 2                # inside dwell: hold
+    assert bc.update(None) == 2               # no data must never relax
+    clock.tick(10)
+    assert bc.update(0.0) == 1
+
+
+# ------------------------------------------------------------- fair share
+def _req(tenant="", max_new=10, priority=0):
+    return GenerateRequest(prompt=[1], max_new_tokens=max_new,
+                           tenant=tenant, priority=priority)
+
+
+def test_token_bucket_fair_share_isolates_tenants():
+    clock = _Clock()
+    bucket = TokenBucketAdmission(
+        BucketConfig(rate_tokens_s=10.0, burst_tokens=20.0), clock=clock)
+    bucket.charge(_req(tenant="a", max_new=15))
+    with pytest.raises(Throttled) as ei:
+        bucket.charge(_req(tenant="a", max_new=15))
+    assert ei.value.status == 429
+    # tenant b is untouched by a's exhaustion — that is the fair share
+    bucket.charge(_req(tenant="b", max_new=15))
+    # refill at the configured rate restores a's budget
+    clock.tick(2.0)
+    bucket.charge(_req(tenant="a", max_new=15))
+    snap = METRICS.snapshot()["counters"]
+    assert snap["control.throttled"] == 1
+    assert snap["tenant.a.throttled"] == 1
+    assert "tenant.b.throttled" not in snap
+
+
+def test_overload_gate_sheds_background_only_at_level_3():
+    clock = _Clock()
+    bc = BrownoutController(None, BrownoutConfig(dwell_s=0.0), clock=clock)
+    gate = OverloadGate(brownout=bc)
+    clock.tick(10)
+    bc.update(9.0)
+    assert bc.level == 3
+    with pytest.raises(Throttled):
+        gate(_req(tenant="bg", priority=1))
+    gate(_req(tenant="fg", priority=0))       # interactive still served
+    clock.tick(10)
+    bc.update(0.0)
+    gate(_req(tenant="bg", priority=1))       # below level 3: admitted
+
+
+# ------------------------------------------------- priority tiers + aging
+def test_interactive_claimed_ahead_of_background():
+    q = RequestQueue(max_depth=8, max_batch_delay_ms=0.0)
+    bg = q.submit(_req(priority=1))
+    fg = q.submit(_req(priority=0))
+    assert q.take(8) == [fg, bg]              # interactive first
+
+
+def test_claim_preempts_unaged_background():
+    q = RequestQueue(max_depth=8, max_batch_delay_ms=0.0, aging_s=60.0)
+    bg = q.submit(_req(priority=1))
+    [p] = q.take(1)
+    assert p is bg
+    fg = q.submit(_req(priority=0))
+    # claim-time arbitration: an interactive arrival bounces the
+    # background claim; False means "skip, not fail" — bg stays pending
+    assert q.claim(bg) is False
+    assert not bg.request or not bg.done()
+    assert METRICS.snapshot()["counters"]["serving.preempted"] == 1
+    assert q.take(8) == [fg, bg]              # bg re-taken after fg
+    assert q.claim(fg) and q.claim(bg)        # no rival now: both admit
+
+
+def test_aged_background_cannot_starve():
+    q = RequestQueue(max_depth=8, max_batch_delay_ms=0.0, aging_s=0.05)
+    bg = q.submit(_req(priority=1))
+    time.sleep(0.06)
+    fg = q.submit(_req(priority=0))
+    assert q.take(8) == [bg, fg]              # aged bg beats interactive
+    q2 = RequestQueue(max_depth=8, max_batch_delay_ms=0.0, aging_s=0.05)
+    bg2 = q2.submit(_req(priority=1))
+    [p] = q2.take(1)
+    time.sleep(0.06)
+    q2.submit(_req(priority=0))
+    assert q2.claim(bg2) is True              # aged: preemption-exempt
+
+
+# ----------------------------------------- queue depth at expiry (bugfix)
+def test_expiry_decrements_depth_gauge_without_a_take():
+    q = RequestQueue(max_depth=8, max_batch_delay_ms=0.0)
+    past = time.monotonic() - 1.0
+    pends = [q.submit(_req()) for _ in range(3)]
+    for p in pends:
+        p.request.deadline_s = past
+    assert METRICS.snapshot()["gauges"]["serving.queue.depth"] == 3
+    # the autoscaler's read path sweeps: dead work leaves the gauge NOW,
+    # not at whatever future take() would have popped it
+    assert q.depth() == 0
+    snap = METRICS.snapshot()
+    assert snap["gauges"]["serving.queue.depth"] == 0
+    assert snap["counters"]["serving.deadline_dropped"] == 3
+    for p in pends:
+        with pytest.raises(DeadlineExceeded):
+            p.result(0)
+
+
+def test_expiry_sweep_frees_room_for_live_submits():
+    q = RequestQueue(max_depth=2, max_batch_delay_ms=0.0)
+    a = q.submit(_req())
+    b = q.submit(_req())
+    a.request.deadline_s = b.request.deadline_s = time.monotonic() - 1.0
+    # full queue of dead work must NOT 429 a live request
+    live = q.submit(_req())
+    assert q.take(8) == [live]
+
+
+@pytest.mark.lockguard
+def test_queue_expiry_contention():
+    """Submitters, takers and depth-pollers hammer one queue while
+    deadlines expire mid-flight; every request resolves exactly once
+    and the depth gauge lands on the true (empty) depth."""
+    q = RequestQueue(max_depth=256, max_batch_delay_ms=0.0, aging_s=0.01)
+    done = threading.Event()
+    taken, lock = [], threading.Lock()
+
+    def submitter(seed):
+        for i in range(40):
+            try:
+                p = q.submit(GenerateRequest(
+                    prompt=[1], max_new_tokens=1,
+                    priority=(seed + i) % 2,
+                    deadline_s=time.monotonic()
+                    + (0.0005 if i % 3 == 0 else 5.0)))
+            except Exception:
+                continue
+            with lock:
+                taken.append(p)
+
+    def taker():
+        while not done.is_set():
+            for p in q.take(4, block_s=0.001):
+                if q.claim(p):
+                    p._complete("served")
+
+    def poller():
+        while not done.is_set():
+            q.depth()
+
+    threads = [threading.Thread(target=submitter, args=(s,))
+               for s in range(4)]
+    threads += [threading.Thread(target=taker) for _ in range(2)]
+    threads.append(threading.Thread(target=poller))
+    for t in threads:
+        t.start()
+    for t in threads[:4]:
+        t.join()
+    deadline = time.monotonic() + 5.0
+    while q.depth() > 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    done.set()
+    for t in threads[4:]:
+        t.join()
+    for p in q.drain():                       # nothing should remain
+        p._fail(DeadlineExceeded("leftover"))
+    # single-shot resolution survived the contention: served XOR failed
+    assert len(taken) == 160
+    served = sum(1 for p in taken if p.done() and p._exc is None)
+    failed = sum(1 for p in taken if p.done() and p._exc is not None)
+    assert served + failed == len(taken)
+    assert METRICS.snapshot()["gauges"]["serving.queue.depth"] == 0
+
+
+# ------------------------------------------- warmed-gated ring admission
+class _WarmableReplica(Replica):
+    """Stub whose healthz mirrors the engine warmed flag."""
+
+    def __init__(self, name, warmed=True):
+        super().__init__(name)
+        self.warmed = warmed
+        self.closed = False
+        self.served = 0
+
+    def generate(self, payload, timeout_s):
+        self.served += 1
+        return {"tokens": [1], "finish_reason": "length",
+                "latency_s": 0.0, "ttft_s": 0.0}
+
+    def healthz(self, timeout_s):
+        return {"ok": True, "engine": {"warmed": self.warmed}}
+
+    def close(self):
+        self.closed = True
+
+
+def test_scale_up_gates_ring_admission_on_warmed(monkeypatch):
+    router = PrefixRouter([_WarmableReplica("r0")],
+                          RouterConfig(page_size=4, affinity_pages=2))
+    cold = _WarmableReplica("r1", warmed=False)
+    admitted_at = []
+
+    def admit():
+        router.scale_up(cold, warm_timeout_s=5.0, poll_s=0.005)
+        admitted_at.append(time.monotonic())
+
+    t = threading.Thread(target=admit)
+    t0 = time.monotonic()
+    t.start()
+    time.sleep(0.08)
+    # still cold: the ring MUST NOT know it — requests keep landing on
+    # the old capacity with no compile-storm node in the walk
+    assert router.pool.names() == ["r0"]
+    cold.warmed = True
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert admitted_at and admitted_at[0] - t0 >= 0.08
+    assert set(router.pool.names()) == {"r0", "r1"}
+    assert router.pool.is_active("r1")
+    assert "r1" in set(router.ring.walk("any-key"))
+    assert METRICS.snapshot()["gauges"]["router.pool_size"] == 2.0
+
+
+def test_scale_up_warm_timeout_fails_safe():
+    router = PrefixRouter([_WarmableReplica("r0")],
+                          RouterConfig(page_size=4, affinity_pages=2))
+    cold = _WarmableReplica("r1", warmed=False)
+    with pytest.raises(TimeoutError, match="refusing ring admission"):
+        router.scale_up(cold, warm_timeout_s=0.05, poll_s=0.005)
+    assert cold.closed                        # not admitted, not leaked
+    assert router.pool.names() == ["r0"]
+
+
+def test_scale_down_drains_then_removes():
+    reps = [_WarmableReplica(f"r{i}") for i in range(2)]
+    router = PrefixRouter(reps, RouterConfig(page_size=4, affinity_pages=2))
+    router.pool.begin_request("r1")           # simulate in-flight work
+    with pytest.raises(TimeoutError, match="reactivated"):
+        router.scale_down("r1", drain_timeout_s=0.05, poll_s=0.005)
+    # fail safe: the drain timed out, so the replica is BACK (active),
+    # never half-removed
+    assert router.pool.is_active("r1")
+    assert set(router.pool.names()) == {"r0", "r1"}
+    router.pool.end_request("r1")
+    rep = router.scale_down("r1", drain_timeout_s=1.0, poll_s=0.005)
+    assert rep is reps[1]
+    assert router.pool.names() == ["r0"]
+    assert "r1" not in set(router.ring.walk("any-key"))
+    snap = METRICS.snapshot()["counters"]
+    assert snap["router.drain_aborts"] == 1
+    assert snap["router.scale_down"] == 1
+
+
+def test_scale_down_refuses_last_replica():
+    router = PrefixRouter([_WarmableReplica("r0")],
+                          RouterConfig(page_size=4, affinity_pages=2))
+    with pytest.raises(RuntimeError, match="last replica"):
+        router.scale_down("r0")
+
+
+def test_autoscaler_over_real_router_seams():
+    """End-to-end over the real seams: burn scales the router up (warmed
+    replica), quiet windows drain one back down, and the chaos kill
+    freezes membership."""
+    from deeplearning4j_tpu.control.autoscaler import router_actuators
+
+    seq = [1]
+    router = PrefixRouter([_WarmableReplica("r0")],
+                          RouterConfig(page_size=4, affinity_pages=2))
+
+    def factory():
+        name = f"r{seq[0]}"
+        seq[0] += 1
+        return _WarmableReplica(name)
+
+    cfg = AutoscalerConfig(cooldown_s=0.0, down_consecutive=1,
+                           max_replicas=3, warm_timeout_s=1.0,
+                           drain_timeout_s=1.0)
+    up, down, size = router_actuators(router, factory, cfg)
+    clock = _Clock()
+    feed = []
+    scaler = Autoscaler(lambda: feed.pop(0), up, down, size,
+                        cfg=cfg, clock=clock)
+    feed.append(BURNING)
+    clock.tick(1)
+    assert scaler.step() == "up" and size() == 2
+    feed.append(QUIET)
+    clock.tick(1)
+    assert scaler.step() == "down" and size() == 1
+    with inject_faults(FaultSpec("control.autoscaler", probability=1.0)):
+        feed.append(BURNING)
+        clock.tick(1)
+        assert scaler.step() is None
+    assert scaler.dead and size() == 1        # static capacity, intact ring
+    assert router.pool.is_active("r0")
+
+
+def test_router_signals_reads_real_evaluators():
+    """`router_signals` wires the live SLOEvaluator / RequestQueue /
+    ForecastEvaluator stack into ControlSignals — burn from the worst
+    full window, depth post-expiry-sweep, TTB by objective NAME (the
+    name-based `ttb_seconds` accessor, +inf when nothing is ramping)."""
+    from deeplearning4j_tpu.control.autoscaler import router_signals
+    from deeplearning4j_tpu.observability import (ForecastEvaluator,
+                                                  MetricsRegistry,
+                                                  SLOEvaluator, SLObjective,
+                                                  TimeSeriesStore)
+
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(registry=reg)
+    obj = SLObjective("ttft", "upper", "serving.ttft.p99", 0.5,
+                      budget=0.05, windows=(8.0, 16.0))
+    slo = SLOEvaluator([obj], store, registry=reg, breach_cooldown_s=1e9)
+    fore = ForecastEvaluator([obj], store, registry=reg, horizon_s=30.0,
+                             window_s=8.0, min_samples=4,
+                             breach_cooldown_s=1e9)
+    queue = RequestQueue(max_depth=8)
+    read = router_signals(slo, queue, "ttft", forecast=fore)
+
+    sig = read()                    # before any samples: all-healthy
+    assert sig.burn is None and sig.queue_depth == 0 and sig.ttb_s is None
+
+    t = 0.0
+    while t <= 20.0:                # ramp crosses the 0.5 objective
+        reg.gauge("serving.ttft.p99", 0.1 + 0.04 * t)
+        store.sample_once(t=t)
+        t += 0.5
+    queue.submit(GenerateRequest(prompt=[1], max_new_tokens=1))
+    sig = read()
+    assert sig.burn is not None and sig.burn > 0
+    assert sig.queue_depth == 1
+    assert sig.ttb_s is not None and sig.ttb_s < 30.0
+    assert fore.ttb_seconds("no-such-objective") is None

@@ -24,6 +24,15 @@ itself failing once via ``checkpoint.reshard``) and asserts training
 finishes on the surviving mesh inside the documented loss window with a
 ``mesh_resize`` flight bundle emitted (DESIGN.md §21).
 
+A fifth leg (``run_overload``, replay with ``--overload --seed N``)
+walks the control plane (DESIGN.md §26): the brownout ladder up and
+down with token parity asserted for everything served at EVERY level
+(the level-2 clamp must serve the exact offline-sample prefix, level 3
+must shed background work while interactive keeps parity), a tight
+fair-share bucket that throttles only the noisy tenant, and a
+``control.autoscaler`` chaos kill mid-run that must freeze a real
+router pool at static capacity with routing still exact.
+
 A fourth leg (``run_online``, replay with ``--online --seed N``) points
 the dice at the online learning loop (DESIGN.md §23): capture damage,
 replay faults, fine-tune step failures, a poisoned publish, an aborted
@@ -682,6 +691,243 @@ def run_online(seed: int) -> dict:
     return result
 
 
+def run_overload(seed: int) -> dict:
+    """Chaos leg for the control plane (DESIGN.md §26), in three phases.
+
+    **Brownout ladder**: a speculative engine is walked up the full
+    ladder (healthy -> spec off -> ``max_new`` clamped -> background
+    shed) by a burn-rate feed and back down one rung at a time.  At
+    EVERY level each served greedy completion must be token-identical
+    to the fault-free ``Transformer.sample`` reference under that
+    level's effective budget (the level-2 clamp serves the exact
+    offline prefix) — brownout trades throughput and length for
+    capacity, never token content.  At level 3 background submissions
+    must 429 while interactive ones keep parity; after descent the
+    engine must be speculative again with full-length parity.
+
+    **Fair share**: a tight per-tenant token bucket is installed; the
+    noisy tenant exhausts its OWN bucket (429 + a
+    ``tenant.noisy.throttled`` row) while the quiet tenant's next
+    request is admitted untouched.
+
+    **Autoscaler kill**: an :class:`Autoscaler` over a REAL router
+    scales 1 -> 2 through the warmed-admission seam, then the
+    ``control.autoscaler`` fault kills the loop mid-run.  The pool must
+    freeze at its current size (static capacity), further pressure
+    windows must take no action, and routing must keep serving with
+    greedy parity — never a half-drained replica or a wrong route.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.control import (Autoscaler, AutoscalerConfig,
+                                            BrownoutConfig,
+                                            BrownoutController, ControlSignals,
+                                            OverloadGate, Throttled,
+                                            TokenBucketAdmission)
+    from deeplearning4j_tpu.control.autoscaler import router_actuators
+    from deeplearning4j_tpu.control.overload import BucketConfig
+    from deeplearning4j_tpu.models import zoo
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+    from deeplearning4j_tpu.serving import (EngineReplica, InferenceEngine,
+                                            PrefixRouter, RouterConfig,
+                                            ServingConfig)
+
+    rng = random.Random(seed + 3)
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=32, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(11))
+    draft, dparams = zoo.draft_lm(cfg, seed=99)
+    engine = InferenceEngine(
+        model, params=params,
+        cfg=ServingConfig(slots=3, resolve_every=2, speculative=True,
+                          spec_k=2),
+        draft_model=draft, draft_params=dparams).start()
+
+    clamp = 4
+    clock = [1000.0]
+    brownout = BrownoutController(
+        engine, BrownoutConfig(enter_burn=(1.0, 2.0, 4.0), exit_fraction=0.5,
+                               dwell_s=5.0, clamp_max_new=clamp),
+        clock=lambda: clock[0])
+    gate = OverloadGate(bucket=TokenBucketAdmission(clock=lambda: clock[0]),
+                        brownout=brownout).install(engine)
+
+    def serve(n: int, priority: int = 0, tenant: str = "quiet"):
+        """Submit n greedy requests; returns (plans, outputs, rejects)."""
+        plans = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                              for _ in range(rng.randint(1, 8))],
+                      max_new_tokens=rng.randint(2, 8), temperature=0.0,
+                      seed=rng.randrange(1 << 16))
+                 for _ in range(n)]
+        handles, rejects = [], 0
+        for p in plans:
+            try:
+                handles.append((p, engine.submit(**p, tenant=tenant,
+                                                 priority=priority)))
+            except Throttled:
+                rejects += 1
+        outs = [(p, h.result(60.0)) for p, h in handles]
+        return plans, outs, rejects
+
+    def parity(outs, effective_cap=None) -> list[str]:
+        bad = []
+        for p, out in outs:
+            n = p["max_new_tokens"] if effective_cap is None \
+                else min(p["max_new_tokens"], effective_cap)
+            exp = model.sample(params, p["prompt"], n, temperature=0.0,
+                               key=jax.random.key(p["seed"]),
+                               kv_cache=True)[len(p["prompt"]):]
+            if out.tokens != exp:
+                bad.append(f"{p}: {out.tokens} != {exp}")
+        return bad
+
+    # ---- phase 1: walk the ladder up, serving with parity at every level
+    ladder: list[dict] = []
+    parity_failures: list[str] = []
+    for burn, want_level in [(0.0, 0), (1.2, 1), (2.5, 2), (5.0, 3)]:
+        clock[0] += brownout.cfg.dwell_s + 1.0   # clears dwell AND refills
+        level = brownout.update(burn)
+        assert level == want_level, (
+            f"seed {seed}: burn {burn} drove level {level}, "
+            f"wanted {want_level}")
+        stats = engine.stats()
+        assert stats["speculative_enabled"] == (level < 1), (level, stats)
+        assert stats["max_new_cap"] == (clamp if level >= 2 else None), \
+            (level, stats)
+        _, outs, _ = serve(3)
+        parity_failures += parity(
+            outs, effective_cap=clamp if level >= 2 else None)
+        shed_rejects = 0
+        if level >= 3:
+            _, bg_outs, shed_rejects = serve(3, priority=1, tenant="batch")
+            assert shed_rejects == 3 and not bg_outs, (
+                f"seed {seed}: level 3 served background work "
+                f"({shed_rejects}/3 shed)")
+        ladder.append({"burn": burn, "level": level, "served": len(outs),
+                       "background_shed": shed_rejects})
+
+    # ---- descend one rung at a time; full quality restored at the bottom
+    for want_level in (2, 1, 0):
+        clock[0] += brownout.cfg.dwell_s + 1.0
+        level = brownout.update(0.1)
+        assert level == want_level, (
+            f"seed {seed}: descent reached {level}, wanted {want_level} "
+            "(must step one rung at a time)")
+    assert engine.stats()["speculative_enabled"] is True
+    clock[0] += brownout.cfg.dwell_s + 1.0
+    _, outs, _ = serve(3)
+    parity_failures += parity(outs)
+
+    # ---- phase 2: tight fair-share bucket — noisy tenant starves itself
+    OverloadGate(bucket=TokenBucketAdmission(
+        BucketConfig(rate_tokens_s=0.0, burst_tokens=20.0),
+        clock=lambda: clock[0]), brownout=brownout).install(engine)
+    noisy_plans = [dict(prompt=[1, 2, 3], max_new_tokens=8, temperature=0.0,
+                        seed=rng.randrange(1 << 16)) for _ in range(5)]
+    noisy_served, noisy_throttled = [], 0
+    for p in noisy_plans:
+        try:
+            noisy_served.append((p, engine.submit(**p, tenant="noisy")))
+        except Throttled:
+            noisy_throttled += 1
+    quiet_plan = dict(prompt=[4, 5, 6], max_new_tokens=8, temperature=0.0,
+                      seed=rng.randrange(1 << 16))
+    quiet_handle = engine.submit(**quiet_plan, tenant="quiet")
+    parity_failures += parity([(p, h.result(60.0)) for p, h in noisy_served])
+    parity_failures += parity([(quiet_plan, quiet_handle.result(60.0))])
+    counters = METRICS.snapshot()["counters"]
+    assert noisy_throttled == 3 and len(noisy_served) == 2, (
+        f"seed {seed}: 20-token bucket admitted {len(noisy_served)}/5 "
+        f"8-token requests ({noisy_throttled} throttled)")
+    assert counters.get("tenant.noisy.throttled", 0) >= 3, counters
+    assert "tenant.quiet.throttled" not in counters, (
+        "quiet tenant was throttled for the noisy tenant's burst")
+    engine.set_admission_hook(None)
+    engine.stop()
+
+    # ---- phase 3: chaos-kill the autoscaler mid-run over a real router
+    def replica(name: str) -> EngineReplica:
+        eng = InferenceEngine(model, params=params,
+                              cfg=ServingConfig(slots=2,
+                                                resolve_every=2)).start()
+        return EngineReplica(name, eng, own_engine=True)
+
+    serial = [0]
+
+    def factory() -> EngineReplica:
+        serial[0] += 1
+        return replica(f"k{serial[0]}")
+
+    router = PrefixRouter([replica("k0")], RouterConfig(
+        page_size=4, probe_interval_s=0.5))
+    acfg = AutoscalerConfig(min_replicas=1, max_replicas=4, cooldown_s=10.0)
+    up, down, size = router_actuators(router, factory, acfg)
+    sim_t, feed = [0.0], []
+    scaler = Autoscaler(lambda: feed.pop(0), up, down, size, acfg,
+                        clock=lambda: sim_t[0])
+
+    def play(sig):
+        sim_t[0] += acfg.cooldown_s + 1.0
+        feed.append(sig)
+        return scaler.step()
+
+    pressure = ControlSignals(burn=3.0, queue_depth=64)
+    took = play(pressure)
+    assert took == "up" and len(router.pool.names()) == 2, (
+        took, router.pool.names())
+    with inject_faults(FaultSpec("control.autoscaler", probability=1.0),
+                       seed=seed):
+        killed_take = play(pressure)
+    frozen = len(router.pool.names())
+    post_kill = [play(pressure) for _ in range(3)]
+    probe = dict(prompt=[3, 1, 4], max_new_tokens=6, temperature=0.0, seed=0)
+    routed = [router.generate(**probe) for _ in range(4)]
+    exp = model.sample(params, probe["prompt"], probe["max_new_tokens"],
+                       temperature=0.0, key=jax.random.key(0),
+                       kv_cache=True)[len(probe["prompt"]):]
+    snap = METRICS.snapshot()
+    router.close()   # pool.close() closes every replica's engine
+
+    result = {
+        "seed": seed,
+        "ladder": ladder,
+        "parity_failures": parity_failures[:5],
+        "brownout_transitions":
+            int(snap["counters"].get("control.brownout_transitions", 0)),
+        "noisy_throttled": noisy_throttled,
+        "shed": int(snap["counters"].get("control.shed", 0)),
+        "autoscaler_killed":
+            int(snap["counters"].get("control.autoscaler_killed", 0)),
+        "pool_after_kill": frozen,
+        "actions_after_kill": [a for a in post_kill if a],
+        "routed_after_kill": len(routed),
+    }
+    assert not parity_failures, (
+        f"seed {seed}: brownout broke token parity: {parity_failures[:3]}")
+    # 3 up + 3 down rungs walked exactly once each
+    assert result["brownout_transitions"] == 6, result
+    assert scaler.dead and killed_take is None, (killed_take, result)
+    assert frozen == 2 and not result["actions_after_kill"], (
+        f"seed {seed}: killed autoscaler kept acting: {result}")
+    assert snap["gauges"].get("control.autoscaler_alive") == 0.0, (
+        "autoscaler death is invisible on the alive gauge")
+    assert all(r["tokens"] == exp for r in routed), (
+        f"seed {seed}: routing broke after the autoscaler died: "
+        f"{[r['tokens'] for r in routed]} != {exp}")
+    assert scaler.start() is False, "a dead autoscaler must not restart"
+    return result
+
+
 def main(argv: list[str]) -> int:
     seed = int(argv[argv.index("--seed") + 1]) if "--seed" in argv else None
     shardguard = None
@@ -716,6 +962,10 @@ def _dispatch_legs(argv: list[str], seed, shardguard) -> int:
         # replay a single failing online-loop draw
         return finish(run_online(seed if seed is not None
                                  else random.SystemRandom().randrange(2 ** 31)))
+    if "--overload" in argv:
+        # replay a single failing overload/brownout draw
+        return finish(run_overload(seed if seed is not None
+                                   else random.SystemRandom().randrange(2 ** 31)))
     if "--stage" in argv:
         # replay a single failing (seed, stage) draw
         stage = int(argv[argv.index("--stage") + 1])
@@ -732,6 +982,7 @@ def _dispatch_legs(argv: list[str], seed, shardguard) -> int:
     result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
     result["elastic"] = run_elastic(base)
     result["online"] = run_online(base)
+    result["overload"] = run_overload(base)
     return finish(result)
 
 

@@ -297,6 +297,41 @@ def render_fleet(snap: dict) -> str | None:
                  ("metric", "value", "min", "med", "max"))
 
 
+def render_control(snap: dict) -> str | None:
+    """Control plane (DESIGN.md §26): autoscaler liveness + actions,
+    brownout level and what it currently costs callers, overload
+    verdicts.  Returns None when no controller ran in this process."""
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    if not any(k.startswith("control.") for k in gauges) and \
+            not any(k.startswith("control.") for k in counters):
+        return None
+    rows = []
+    for name, label in (("control.autoscaler_alive", "autoscaler_alive"),
+                        ("control.pool_size", "pool_size"),
+                        ("control.brownout_level", "brownout_level"),
+                        ("serving.speculative_enabled", "speculative_enabled"),
+                        ("serving.max_new_cap", "max_new_cap")):
+        if name in gauges:
+            rows.append((label, f"{gauges[name]:.6g}"))
+    for name, label in (("control.scale_up", "scale_ups"),
+                        ("control.scale_down", "scale_downs"),
+                        ("control.scale_errors", "scale_errors"),
+                        ("control.errors", "loop_errors"),
+                        ("control.autoscaler_killed", "autoscaler_killed"),
+                        ("control.brownout_transitions",
+                         "brownout_transitions"),
+                        ("control.throttled", "throttled"),
+                        ("control.shed", "background_shed"),
+                        ("serving.preempted", "preempted"),
+                        ("serving.max_new_clamped", "max_new_clamped")):
+        if name in counters:
+            rows.append((label, f"{counters[name]:.0f}"))
+    if not rows:
+        return None
+    return _rows("control (autoscaler + overload)", rows, ("metric", "value"))
+
+
 def render_tenants(snap: dict, top_k: int = 10) -> str | None:
     """Per-tenant accounting (ISSUE 16): the ``tenant.<label>.*``
     counters fed through the bounded :class:`TenantLabels` fold, ranked
@@ -406,6 +441,7 @@ def render_metrics(snap: dict) -> str:
         parts.append(state_mem)
     for section in (render_serving(snap), render_kv_capacity(snap),
                     render_router(snap), render_fleet(snap),
+                    render_control(snap),
                     render_tenants(snap), render_elasticity(snap),
                     render_online(snap), render_goodput(snap),
                     render_forecast(snap), render_utilization(snap)):

@@ -61,6 +61,7 @@ SERIES: tuple[tuple[str, tuple[str, ...], str], ...] = (
     ("goodput_fraction",
      ("goodput.fraction", "goodput_fraction"), "higher"),
     ("fleet_scrape_ms", ("fleet.scrape_ms",), "lower"),
+    ("replica_hours_saved_frac", ("autoscale.saved_frac",), "higher"),
 )
 
 DIRECTIONS = {name: direction for name, _, direction in SERIES}

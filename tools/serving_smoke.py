@@ -13,6 +13,16 @@ histograms land.
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
                                   [--lockguard] [--prefix-workload]
                                   [--trace-out trace.json] [--slo] [--online]
+                                  [--autoscale]
+
+``--autoscale`` switches to the control-plane leg (DESIGN.md §26): an
+``Autoscaler`` scales a live router pool 1 -> 2 -> 1 through the real
+warm-before-admission / drain-before-remove seams with a greedy probe
+held token-identical across every membership change, then the same
+controller runs a deterministic diurnal-plus-spike day and must hold
+the TTFT objective (>= 95% of simulated time) with measurably fewer
+replica-hours than a static peak-provisioned fleet.  The JSON line
+carries ``{"autoscale": {"saved_frac": ...}}`` for ``perf_gate.py``.
 
 ``--online`` switches to the online-learning leg (DESIGN.md §23): waves
 of greedy traffic are served through a ``ModelServer`` whose capture
@@ -1222,6 +1232,230 @@ def run_fleet(requests: int = 36, threads: int = 6, seed: int = 0,
     return result
 
 
+def run_autoscale(seed: int = 0, requests: int = 24, threads: int = 4,
+                  day_s: float = 86400.0) -> dict:
+    """The ``--autoscale`` leg (DESIGN.md §26), in two phases.
+
+    **Real seams**: a scripted-signal :class:`Autoscaler` wired through
+    ``router_actuators`` scales a live ``RouterServer`` pool 1 -> 2 -> 1.
+    The scale-up replica warms BEFORE ring admission, the scale-down
+    rides the quarantine drain path, and a fixed greedy probe must stay
+    token-identical to offline ``Transformer.sample`` across every
+    membership change — elasticity must never cost correctness.
+
+    **Diurnal-plus-spike**: the same controller (real ``evaluate``/
+    ``step`` logic, injected clock) runs over a deterministic fluid
+    model of one simulated day — a diurnal sine plus an afternoon
+    spike, fixed per-replica service rate, queue carried between
+    windows.  The run FAILS unless the TTFT objective holds for >= 95%
+    of simulated time (the SLO budget) while the autoscaler burns
+    measurably fewer replica-hours than a static fleet provisioned for
+    the peak.  The JSON line carries
+    ``{"autoscale": {"saved_frac": ...}}`` for ``perf_gate.py``.
+    """
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.control import (Autoscaler, AutoscalerConfig,
+                                            ControlSignals)
+    from deeplearning4j_tpu.control.autoscaler import router_actuators
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.serving import (EngineReplica, InferenceEngine,
+                                            PrefixRouter, RouterConfig,
+                                            RouterServer, ServingClient,
+                                            ServingConfig, ServingError)
+
+    observability.enable()
+    METRICS.reset()
+    rng = random.Random(seed)
+
+    # ---- phase 1: the controller over the real router seams -------------
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=32, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+    scfg = ServingConfig(slots=2, resolve_every=2)
+
+    def replica(name: str) -> EngineReplica:
+        eng = InferenceEngine(model, params=params, cfg=scfg).start()
+        return EngineReplica(name, eng, own_engine=True)
+
+    probe = dict(prompt=[3, 1, 4, 1, 5], max_new_tokens=8, temperature=0.0,
+                 seed=0)
+    expected = model.sample(params, probe["prompt"], probe["max_new_tokens"],
+                            temperature=0.0, key=jax.random.key(0),
+                            kv_cache=True)[len(probe["prompt"]):]
+
+    acfg = AutoscalerConfig(min_replicas=1, max_replicas=2, cooldown_s=10.0,
+                            down_consecutive=2, warm_timeout_s=60.0,
+                            drain_timeout_s=30.0)
+    feed: list[ControlSignals] = []
+    sim_t = [0.0]
+    serial = [0]
+
+    def factory() -> EngineReplica:
+        serial[0] += 1
+        return replica(f"a{serial[0]}")
+
+    router = PrefixRouter([replica("a0")], RouterConfig(
+        page_size=4, probe_interval_s=0.5, fail_threshold=2,
+        recover_threshold=2))
+    up, down, size = router_actuators(router, factory, acfg)
+    scaler = Autoscaler(lambda: feed.pop(0), up, down, size, acfg,
+                        clock=lambda: sim_t[0])
+
+    failures: list[str] = []
+    probes: list[list[int]] = []
+    pool_sizes: list[int] = []
+    lock = threading.Lock()
+
+    def drive(client, n: int) -> None:
+        plans = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                              for _ in range(rng.randint(2, 8))],
+                      max_new_tokens=rng.randint(1, 6),
+                      temperature=rng.choice([0.0, 0.7]),
+                      seed=rng.randrange(1 << 20))
+                 for _ in range(n)]
+        def worker(mine):
+            for plan in mine:
+                try:
+                    client.generate(**plan)
+                except ServingError as e:
+                    with lock:
+                        failures.append(str(e))
+        ts = [threading.Thread(target=worker, args=(plans[i::threads],))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    def play(sig: ControlSignals) -> str | None:
+        sim_t[0] += acfg.cooldown_s + 1.0
+        feed.append(sig)
+        return scaler.step()
+
+    with RouterServer(router) as server:
+        client = ServingClient(port=server.port)
+        probes.append(client.generate(**probe)["tokens"])
+        drive(client, requests // 3)
+        pool_sizes.append(len(router.pool.names()))
+
+        took_up = play(ControlSignals(burn=2.0, queue_depth=40))
+        pool_sizes.append(len(router.pool.names()))
+        probes.append(client.generate(**probe)["tokens"])
+        drive(client, requests // 3)
+
+        took_down = None
+        for _ in range(acfg.down_consecutive + 1):
+            took_down = play(ControlSignals(burn=0.0, queue_depth=0)) \
+                or took_down
+        pool_sizes.append(len(router.pool.names()))
+        probes.append(client.generate(**probe)["tokens"])
+        drive(client, requests - 2 * (requests // 3))
+
+    snap = METRICS.snapshot()
+    router.close()
+
+    # ---- phase 2: diurnal + spike fluid model over one simulated day ----
+    dt, cap, ttft_target = 60.0, 10.0, 1.0
+
+    def lam(t: float) -> float:
+        diurnal = 8.0 + 52.0 * (0.5 - 0.5 * math.cos(2 * math.pi * t / day_s))
+        spike = 30.0 if 0.55 * day_s <= t < 0.62 * day_s else 0.0
+        return diurnal + spike
+
+    peak = max(lam(i * dt) for i in range(int(day_s / dt)))
+    n_static = math.ceil(peak / cap)
+
+    def simulate(elastic: bool) -> dict:
+        state = {"n": n_static if not elastic else 2, "t": 0.0}
+        fcfg = AutoscalerConfig(interval_s=dt, min_replicas=1,
+                                max_replicas=n_static + 2, cooldown_s=2 * dt,
+                                burn_up=1.0, burn_down=0.55, queue_high=50,
+                                queue_low=5, down_consecutive=5)
+        sig_box: list[ControlSignals] = [ControlSignals()]
+
+        def bump(delta):
+            def act():
+                state["n"] += delta
+            return act
+
+        ctl = Autoscaler(lambda: sig_box[0], bump(+1), bump(-1),
+                         lambda: state["n"], fcfg, clock=lambda: state["t"])
+        q = replica_s = ok_s = 0.0
+        actions = {"up": 0, "down": 0}
+        for i in range(int(day_s / dt)):
+            t = i * dt
+            state["t"] = t
+            n = state["n"]
+            served = min(q + lam(t) * dt, n * cap * dt)
+            q = max(0.0, q + lam(t) * dt - served)
+            ttft = 0.05 + q / (n * cap)
+            replica_s += n * dt
+            ok_s += dt if ttft <= ttft_target else 0.0
+            if elastic:
+                # burn against an 80%-utilisation budget: queue growth is
+                # the breach, sustained high utilisation is the warning
+                sig_box[0] = ControlSignals(
+                    burn=lam(t) / (n * cap) / 0.8, queue_depth=int(q))
+                took = ctl.step()
+                if took:
+                    actions[took] += 1
+        return {"replica_hours": replica_s / 3600.0,
+                "ttft_ok_frac": ok_s / day_s,
+                "scale_ups": actions["up"], "scale_downs": actions["down"],
+                "final_n": state["n"]}
+
+    elastic = simulate(elastic=True)
+    static = simulate(elastic=False)
+    saved = 1.0 - elastic["replica_hours"] / static["replica_hours"]
+
+    result = {
+        "workload": "autoscale",
+        "seed": seed,
+        "probe_parity": all(p == expected for p in probes),
+        "pool_sizes": pool_sizes,
+        "actions_real": [took_up, took_down],
+        "router_scale_up": snap["counters"].get("router.scale_up", 0.0),
+        "router_scale_down": snap["counters"].get("router.scale_down", 0.0),
+        "control_scale_up": snap["counters"].get("control.scale_up", 0.0),
+        "control_scale_down": snap["counters"].get("control.scale_down", 0.0),
+        "failures": failures[:5],
+        "static_peak_replicas": n_static,
+        "elastic": elastic,
+        "static": {k: static[k] for k in ("replica_hours", "ttft_ok_frac")},
+        "autoscale": {"saved_frac": round(saved, 4),
+                      "replica_hours": round(elastic["replica_hours"], 3),
+                      "static_replica_hours": round(static["replica_hours"],
+                                                    3)},
+    }
+    assert not failures, failures[:5]
+    assert result["probe_parity"], (
+        f"greedy probe diverged across scale events: {probes} != {expected}")
+    assert pool_sizes == [1, 2, 1], (
+        f"pool did not scale 1 -> 2 -> 1 through the real seams: "
+        f"{pool_sizes} (actions {took_up!r}/{took_down!r})")
+    assert took_up == "up" and took_down == "down", (took_up, took_down)
+    assert result["router_scale_up"] >= 1.0 \
+        and result["router_scale_down"] >= 1.0, snap["counters"]
+    assert static["ttft_ok_frac"] == 1.0, (
+        f"static-peak baseline itself breached TTFT: {static}")
+    assert elastic["ttft_ok_frac"] >= 0.95, (
+        f"autoscaler failed to hold the TTFT objective: {elastic}")
+    assert elastic["scale_ups"] >= 2 and elastic["scale_downs"] >= 2, elastic
+    assert saved >= 0.2, (
+        f"autoscaling saved only {saved:.1%} replica-hours vs static peak "
+        f"({elastic['replica_hours']:.1f}h vs {static['replica_hours']:.1f}h)")
+    return result
+
+
 def main(argv: list[str]) -> int:
     def arg(flag, default, cast=int):
         return cast(argv[argv.index(flag) + 1]) if flag in argv else default
@@ -1233,6 +1467,12 @@ def main(argv: list[str]) -> int:
                          rounds=arg("--rounds", 2))
         print(json.dumps(out))
         return 0 if out["ok"] else 1
+    if "--autoscale" in argv:
+        out = run_autoscale(seed=arg("--seed", 0),
+                            requests=arg("--requests", 24),
+                            threads=arg("--threads", 4))
+        print(json.dumps(out))
+        return 0
     if "--fleet" in argv:
         out = run_fleet(requests=arg("--requests", 36),
                         threads=arg("--threads", 6),
