@@ -1,4 +1,4 @@
-"""The graftlint rule set — twenty-six hazard classes from this repo's
+"""The graftlint rule set — twenty-seven hazard classes from this repo's
 history.
 
 | rule  | hazard                                                           |
@@ -81,6 +81,11 @@ history.
 |       | seams (`scale_up`/`scale_down`/`drain_replica`); touching a      |
 |       | `HashRing` or the pool's internals directly skips the warmed     |
 |       | gate and the drain state machine                                 |
+| DG01  | page accounting or block-table write in `serving/disagg/`        |
+|       | outside the `KVMigrator` export/import seams — migration's       |
+|       | refcount-handoff invariant (PG01 extended across the process     |
+|       | boundary) holds only because every acquire/release funnels       |
+|       | through the migrator                                             |
 
 Each rule documents its known blind spots; deliberate hits are silenced
 inline with ``# graftlint: disable=<RULE>`` plus a reason, or carried in
@@ -1103,7 +1108,10 @@ class ThreadLifecycleRule(Rule):
 #: PagePool methods that hand the caller page references it must release
 _PG_ACQUIRE = {"alloc", "incref", "lookup_prefix"}
 #: methods that give references back (any one on an exit path clears PG01)
-_PG_RELEASE = {"decref", "free", "release", "reset"}
+#: — decref_quarantine is the off-serve-thread release (migration abort):
+#: it drops the reference without making the page allocatable, which is
+#: still a release for leak purposes
+_PG_RELEASE = {"decref", "decref_quarantine", "free", "release", "reset"}
 
 
 @register
@@ -2229,3 +2237,92 @@ class ControlSeamRule(Rule):
                         "pool's public membership seams "
                         "(`add_replica`/`drain_replica`/"
                         "`remove_replica`/`inflight`)")
+
+
+#: page-accounting calls that move refcounts or hand pages across tiers —
+#: inside serving/disagg/ these belong to the KVMigrator seams only
+_DG_ACCOUNTING = {
+    "alloc", "incref", "decref", "decref_quarantine", "lookup_prefix",
+    "insert_prefix", "clear_prefix", "requeue", "queue_wipe",
+    "admit_from_pages",
+}
+
+
+@register
+class DisaggSeamRule(Rule):
+    """DG01: page accounting or block-table writes in ``serving/disagg/``
+    outside the ``KVMigrator`` export/import seams.
+
+    The disagg tier's whole correctness story is one invariant: a
+    migrated request's page refcounts hand off ATOMICALLY — the decode
+    pool's claims plus fresh allocations transfer to the engine in the
+    same step that queues the request, and every abort path releases
+    exactly what it acquired (the chaos legs assert refcounts balance to
+    zero leaked pages).  That invariant is auditable only because every
+    pool acquire/release and block-table mutation in the package funnels
+    through the :class:`~..serving.disagg.migrate.KVMigrator`'s seams —
+    PG01's acquire/release discipline extended across the process
+    boundary.  A scheduler (or future tier code) that increfs a page or
+    pokes a block table itself reintroduces the scattered-refcount bug
+    class the seam exists to kill.
+
+    Fires, in modules whose path contains ``serving/disagg``, on (a) any
+    call whose attribute is a page-accounting method (``alloc``,
+    ``incref``, ``decref``, ``decref_quarantine``, ``lookup_prefix``,
+    ``insert_prefix``, ``clear_prefix``, ``requeue``, ``queue_wipe``,
+    ``admit_from_pages``) and (b) any assignment whose target mentions a
+    block table (``bt`` / ``block_table``) — when the enclosing class is
+    not ``KVMigrator``.
+
+    Blind spots: accounting reached through a helper defined outside the
+    package (the helper's own module gets PG01 instead), and a pool
+    aliased into a collection.  Silence a deliberate hit with
+    ``# graftlint: disable=DG01`` plus the reason.
+    """
+
+    id = "DG01"
+    title = "disagg page accounting outside the KVMigrator seams"
+
+    def check(self, module: ModuleInfo) -> Iterator[Finding]:
+        path = module.path.replace("\\", "/")
+        if "serving/disagg" not in path:
+            return
+        # map every node to its enclosing class, one walk
+        owner: dict[int, str] = {}
+
+        def _mark(node: ast.AST, cls: str | None) -> None:
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+            for child in ast.iter_child_nodes(node):
+                if cls is not None:
+                    owner[id(child)] = cls
+                _mark(child, cls)
+
+        _mark(module.tree, None)
+        for node in ast.walk(module.tree):
+            if owner.get(id(node)) == "KVMigrator":
+                continue
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DG_ACCOUNTING:
+                recv = dotted_name(node.func.value) or "<expr>"
+                yield self.finding(
+                    module, node,
+                    f"`{recv}.{node.func.attr}` moves page references "
+                    "outside the KVMigrator export/import seams — route "
+                    "it through the migrator so the refcount handoff "
+                    "stays atomic and auditable (DESIGN.md §27)")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    name = (dotted_name(t) or "").lower()
+                    seg = name.rsplit(".", 1)[-1]
+                    if seg == "bt" or "block_table" in name:
+                        yield self.finding(
+                            module, t,
+                            f"assignment to `{dotted_name(t)}` writes a "
+                            "block table outside the KVMigrator seams — "
+                            "block-table rows are installed only by the "
+                            "engine's admit path on the migrator's "
+                            "behalf (DESIGN.md §27)")

@@ -100,6 +100,18 @@ site                   effect when armed
                        the pool freezes at its current size (static
                        capacity), routing and drain state untouched;
                        ``control.autoscaler_alive`` drops to 0
+``disagg.prefill_worker``  :class:`WorkerKilled` raised in a disagg
+                       prefill worker (``serving/disagg/scheduler.py``) —
+                       the worker thread dies with the request claimed;
+                       the scheduler releases any prefill record, requeues
+                       the request at the head of its tier, and respawns a
+                       twin.  Decode state is never touched
+``disagg.migrate``     :class:`TransientStepFault` raised inside
+                       ``KVMigrator.migrate`` — before the decode-side
+                       claim or mid-transfer with references held on both
+                       sides; the unwind quarantines every claimed page and
+                       the scheduler requeues (refcounts must balance to
+                       zero leaked pages — the chaos-leg assertion)
 =====================  =====================================================
 
 Arming:
@@ -218,6 +230,11 @@ _SITE_EXC: dict[str, type[InjectedFault]] = {
     "online.reload": TransientStepFault,
     "online.rollback": TransientStepFault,
     "control.autoscaler": TransientStepFault,
+    # disagg tier (DESIGN.md §27): a killed prefill worker dies like a
+    # scaleout worker (thread exits, twin respawns); a migrate fault is
+    # transient — the scheduler requeues, refcounts must balance
+    "disagg.prefill_worker": WorkerKilled,
+    "disagg.migrate": TransientStepFault,
 }
 
 

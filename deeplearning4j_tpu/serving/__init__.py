@@ -15,17 +15,25 @@ from .batcher import (Completion, DeadlineExceeded, GenerateRequest,
 from .client import ServingClient, ServingError
 from .engine import BatchScorer, InferenceEngine, ServingConfig
 from .paging import PagePool, prefix_chain_keys
+from .engine import MigrationRejected, MigrationTicket, PrefillRecord
 from .router import (AllReplicasUnavailable, EngineReplica, HashRing,
                      PrefixRouter, ProcessReplica, ReplicaPool,
                      ReplicaUnavailable, RouterConfig, RouterServer)
 from .server import ModelServer
+from .disagg import DisaggScheduler, KVMigrator, TransferPlan
 
 __all__ = [
     "AllReplicasUnavailable",
     "BatchScorer",
     "Completion",
     "DeadlineExceeded",
+    "DisaggScheduler",
     "EngineReplica",
+    "KVMigrator",
+    "MigrationRejected",
+    "MigrationTicket",
+    "PrefillRecord",
+    "TransferPlan",
     "GenerateRequest",
     "HashRing",
     "InferenceEngine",

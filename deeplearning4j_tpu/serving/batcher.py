@@ -188,10 +188,18 @@ class RequestQueue:
     """
 
     def __init__(self, max_depth: int = 64, max_batch_delay_ms: float = 2.0,
-                 aging_s: float = 2.0):
+                 aging_s: float = 2.0,
+                 depth_gauge: str = "serving.queue.depth"):
         self.max_depth = max_depth
         self.max_batch_delay_ms = max_batch_delay_ms
         self.aging_s = aging_s
+        # per-tier depth gauge name: a prefill-role engine publishes
+        # ``serving.queue.depth.prefill``, a decode/unified one
+        # ``serving.queue.depth`` (or ``.decode``) — the autoscaler can
+        # then tell prefill pressure from decode pressure, and the
+        # expiry sweep decrements the RIGHT tier because the sweep and
+        # the gauge live on the same object
+        self.depth_gauge = depth_gauge
         self._cv = threading.Condition()
         # index = priority tier: [INTERACTIVE, BACKGROUND]
         self._tiers: list[deque[PendingResult]] = [deque(), deque()]
@@ -225,7 +233,7 @@ class RequestQueue:
             tier.extend(live)
             swept = True
         if swept:
-            METRICS.gauge("serving.queue.depth", self._total_locked())
+            METRICS.gauge(self.depth_gauge, self._total_locked())
 
     def _pop_locked(self, now: float) -> PendingResult:
         """Next request in service order: an AGED background head beats
@@ -261,7 +269,7 @@ class RequestQueue:
             tier = BACKGROUND if getattr(request, "priority", 0) > 0 \
                 else INTERACTIVE
             self._tiers[tier].append(pending)
-            METRICS.gauge("serving.queue.depth", self._total_locked())
+            METRICS.gauge(self.depth_gauge, self._total_locked())
             self._cv.notify()
         return pending
 
@@ -311,7 +319,7 @@ class RequestQueue:
                                 getattr(p.request, "tenant", ""),
                                 now - p.request.submitted_s)
                 out.append(p)
-            METRICS.gauge("serving.queue.depth", self._total_locked())
+            METRICS.gauge(self.depth_gauge, self._total_locked())
         return out
 
     def claim(self, p: PendingResult) -> bool:
@@ -351,7 +359,7 @@ class RequestQueue:
                     and now - p.request.submitted_s < self.aging_s):
                 self._tiers[BACKGROUND].appendleft(p)
                 METRICS.increment("serving.preempted")
-                METRICS.gauge("serving.queue.depth", self._total_locked())
+                METRICS.gauge(self.depth_gauge, self._total_locked())
                 self._cv.notify()
                 return False
             return True
@@ -381,5 +389,19 @@ class RequestQueue:
                 + list(self._tiers[BACKGROUND])
             for tier in self._tiers:
                 tier.clear()
-            METRICS.gauge("serving.queue.depth", 0)
+            METRICS.gauge(self.depth_gauge, 0)
         return out
+
+    def unclaim(self, p: PendingResult) -> None:
+        """Push a previously taken request back to the HEAD of its tier
+        (disagg prefill-worker death: the scheduler requeues the request
+        rather than failing it — head position preserves arrival order
+        so a chaos-killed worker costs latency, never fairness)."""
+        with self._cv:
+            if p.done():
+                return               # already failed (expiry/shutdown)
+            tier = BACKGROUND if getattr(p.request, "priority", 0) > 0 \
+                else INTERACTIVE
+            self._tiers[tier].appendleft(p)
+            METRICS.gauge(self.depth_gauge, self._total_locked())
+            self._cv.notify()

@@ -109,6 +109,21 @@ class ServingClient:
         return self._json("/v1/score", {"inputs": [list(map(float, r))
                                                    for r in inputs]})["outputs"]
 
+    def migrate_probe(self, prompt, timeout_s: float | None = None) -> dict:
+        """Ask the decode side which prompt positions are already
+        resident (``{"cached_len", "page_size"}``) — the export plans
+        its wire payload around the answer: resident pages ship as
+        hash-only claims, zero bytes."""
+        return self._json("/v1/migrate", {"probe": {"prompt": list(prompt)}},
+                          timeout_s=timeout_s)
+
+    def migrate(self, payload: dict, timeout_s: float | None = None) -> dict:
+        """Submit a ``KVMigrator.export_payload`` wire migration and
+        block until decode completes (answers like :meth:`generate`).
+        A 409 means the probed prefix was evicted between probe and
+        import — re-export with full bytes and resubmit."""
+        return self._json("/v1/migrate", payload, timeout_s=timeout_s)
+
     def reload(self, step: int | None = None) -> int:
         body = {} if step is None else {"step": step}
         return self._json("/v1/reload", body)["step"]

@@ -1,0 +1,28 @@
+"""Disaggregated prefill/decode serving (DESIGN.md §27).
+
+Prefill is compute-bound and bursty; decode is memory-bound and steady.
+Colocated, each ruins the other's tail: a long prompt landing
+mid-decode-segment spikes inter-token p99, and a deep decode batch
+queues prompts behind it.  This package splits them into TIERS —
+prefill-role engines that fill KV pages and never decode, a
+:class:`KVMigrator` that moves a request's pages to a decode engine
+(content-addressed, so resident pages transfer as a hash-only claim),
+and a :class:`DisaggScheduler` that drives the pipeline and requeues
+(never corrupts) when chaos kills a prefill worker.
+
+Page-accounting discipline: every pool acquire/release and block-table
+write in this package lives inside the KVMigrator's export/import seams
+— graftlint DG01 fails anything else.
+"""
+
+from .migrate import (KVMigrator, PageTransfer, TransferPlan,
+                      export_payload)
+from .scheduler import DisaggScheduler
+
+__all__ = [
+    "DisaggScheduler",
+    "KVMigrator",
+    "PageTransfer",
+    "TransferPlan",
+    "export_payload",
+]

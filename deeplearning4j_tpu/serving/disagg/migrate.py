@@ -1,0 +1,344 @@
+"""KV-page migration between serving tiers (DESIGN.md §27).
+
+A migrated request is pages plus a block-table row — the paging layer
+(§17) already made both portable.  What this module adds is the
+PROTOCOL: an explicit per-request transfer schedule (which pages move,
+which transfer as hash-only claims), atomic refcount handoff on the
+decode side, and an exceptional-path discipline that releases every
+acquired reference (graftlint PG01/DG01).
+
+Content addressing does the heavy lifting.  The decode pool's prefix
+cache keys chains of full token pages by chained blake2b — the SAME
+keys the prefill side's pages carry — so a page that already exists on
+the decode side transfers as ``(hash, claim)``: one incref, zero bytes
+moved.  Only pages beyond the decode-resident prefix ship bytes, and
+pages that exist purely as decode budget (no prefill content) ship
+nothing at all — they are allocated empty on arrival.
+
+int8/GQA transparency: :meth:`InferenceEngine.read_pages` exports
+whatever the pool stores (``k``/``v`` plus ``k_scale``/``v_scale``
+under kv_quant), and the import scatters those bytes verbatim — no
+requantization, so a moved page is byte-identical to the page the
+prefill wrote, and quantized prefix aliasing stays sound on the far
+side (identical content ⇒ identical bytes, §20).
+
+Failure contract: an unwind ANYWHERE releases the decode-side claims
+via :meth:`PagePool.decref_quarantine` and hands the dead pages to the
+decode engine's serve thread for wiping (wipe-before-reallocatable —
+the migrator thread must never touch device state it does not own).
+The prefill-side record stays with the caller until the moment its
+bytes have been read, then is released; re-running the migration after
+any abort is always safe.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import time
+
+import numpy as np
+
+from ...observability import METRICS
+from ...resilience.faults import FAULTS
+from ..batcher import GenerateRequest, PendingResult
+from ..engine import MigrationRejected, MigrationTicket, PrefillRecord
+
+__all__ = ["KVMigrator", "PageTransfer", "TransferPlan", "export_payload"]
+
+
+@dataclasses.dataclass
+class PageTransfer:
+    """One block-table position in a migration schedule."""
+
+    index: int            # position in the block-table row
+    key: str | None       # chained content hash (None: no full-page key)
+    action: str           # "claim" | "move" | "alloc"
+
+
+@dataclasses.dataclass
+class TransferPlan:
+    """Per-request transfer schedule: for every page the request needs
+    on the decode side, whether it transfers as a hash-only claim
+    (resident — incref, zero bytes), a byte move (prefill content the
+    decode side lacks), or a bare allocation (decode budget, no content
+    to move).  Planned per request, in the spirit of an explicit
+    collective redistribution schedule rather than ad-hoc copies."""
+
+    entries: list[PageTransfer]
+
+    def count(self, action: str) -> int:
+        return sum(1 for e in self.entries if e.action == action)
+
+    @property
+    def pages_moved(self) -> int:
+        return self.count("move")
+
+    @property
+    def pages_deduped(self) -> int:
+        return self.count("claim")
+
+
+def _page_counts(prompt_len: int, max_new: int, page_size: int):
+    """(content pages, total pages) for a request: prefill writes K/V
+    for positions ``[0, p-1)`` (the last token is the first decode
+    query), so only ``ceil((p-1)/ps)`` pages carry bytes; the rest of
+    the ``ceil((p+max_new)/ps)`` block-table row is decode budget."""
+    n_content = -(-(prompt_len - 1) // page_size)
+    n_total = -(-(prompt_len + max_new) // page_size)
+    return n_content, n_total
+
+
+class KVMigrator:
+    """Moves prefilled requests into a decode engine's page pool.
+
+    All page accounting for the disagg tier funnels through here: the
+    export seam (:meth:`export_payload` — read bytes, release the
+    prefill record) and the import seam (:meth:`migrate` /
+    :meth:`import_payload` — claim, alloc, upload, hand off to
+    :meth:`InferenceEngine.admit_from_pages`).  graftlint DG01 fails
+    pool calls or block-table writes anywhere else in
+    ``serving/disagg/``.
+    """
+
+    def __init__(self, decode_engine):
+        if decode_engine.page_pool is None:
+            raise ValueError("decode engine must be paged — the "
+                             "migration unit is a KV page")
+        if decode_engine.cfg.role == "prefill":
+            raise ValueError("cannot migrate INTO a prefill-role engine")
+        self.decode = decode_engine
+
+    # ------------------------------------------------------------ planning
+    def plan_transfer(self, prompt: list[int], max_new_tokens: int,
+                      cached_len: int | None = None) -> TransferPlan:
+        """Advisory schedule for migrating ``prompt``: which block-table
+        positions claim, move, or allocate.  ``cached_len`` overrides
+        the decode pool's :meth:`~..paging.PagePool.peek_prefix` answer
+        (the HTTP probe passes its own).  Advisory because the import
+        claim re-walks the chain atomically and may find more or fewer
+        resident pages — the executed plan is returned by
+        :meth:`migrate`."""
+        pool = self.decode.page_pool
+        ps = pool.page_size
+        usable = len(prompt) - 1
+        if cached_len is None:
+            cached_len = pool.peek_prefix(prompt, usable)
+        keys = pool.chain_keys(prompt, usable)
+        n_claim = cached_len // ps
+        n_content, n_total = _page_counts(len(prompt), max_new_tokens, ps)
+        entries = []
+        for i in range(n_total):
+            key = keys[i] if i < len(keys) else None
+            action = ("claim" if i < n_claim
+                      else "move" if i < n_content else "alloc")
+            entries.append(PageTransfer(index=i, key=key, action=action))
+        return TransferPlan(entries)
+
+    # ------------------------------------------------------------ live path
+    def migrate(self, src, record: PrefillRecord,
+                pending: PendingResult) -> tuple[MigrationTicket,
+                                                 TransferPlan]:
+        """Move ``record``'s KV from prefill engine ``src`` into the
+        decode engine and queue ``pending`` for admission between
+        decode segments.  Returns the admission ticket plus the plan as
+        EXECUTED (claims reflect what the atomic lookup actually found).
+
+        Ownership: the decode-side pages hand off atomically to
+        :meth:`~..engine.InferenceEngine.admit_from_pages`; ``record``
+        is consumed (its pages released on the prefill side) once its
+        bytes are safely read.  On ANY unwind before that, the record
+        is untouched — the caller releases or retries it — and every
+        decode-side reference acquired here is quarantined back.
+        """
+        pool = self.decode.page_pool
+        ps = pool.page_size
+        prompt = record.prompt
+        n_content, n_total = _page_counts(len(prompt),
+                                          record.max_new_tokens, ps)
+        with METRICS.time("disagg.migrate_seconds"):
+            FAULTS.maybe_fire("disagg.migrate")
+            claimed: list[int] = []
+            fresh: list[int] = []
+            try:
+                # generation stamp BEFORE the claim: if a reload lands
+                # between this read and admission, the drain fence
+                # rejects the ticket and we re-plan — claimed pages
+                # computed under superseded weights can never decode
+                gen = int(self.decode.stats()["generation"])
+                usable = len(prompt) - 1
+                claimed, _cached = pool.lookup_prefix(prompt, usable)
+                n_claim = len(claimed)
+                fresh = pool.alloc(n_total - n_claim)
+                # mid-migration kill point: references held on both
+                # sides, nothing admitted — the unwind below must leave
+                # refcounts exactly balanced (the chaos-leg assertion)
+                FAULTS.maybe_fire("disagg.migrate")
+                uploads = []
+                if n_claim < n_content:
+                    raw = src.read_pages(record.pages[n_claim:n_content])
+                    for j in range(n_content - n_claim):
+                        layers = [{name: arr[j]
+                                   for name, arr in layer.items()}
+                                  for layer in raw]
+                        uploads.append((fresh[j], layers))
+            except BaseException:
+                dead = pool.decref_quarantine(claimed + fresh)
+                self.decode.queue_wipe(dead)
+                raise
+            # bytes are read: the prefill side's copy is now redundant
+            src.release_prefill(record)
+            keys = pool.chain_keys(prompt, usable)
+            plan = TransferPlan([
+                PageTransfer(
+                    index=i, key=keys[i] if i < len(keys) else None,
+                    action=("claim" if i < n_claim
+                            else "move" if i < n_content else "alloc"))
+                for i in range(n_total)])
+            METRICS.increment("disagg.migrations")
+            METRICS.increment("disagg.pages_moved", len(uploads))
+            METRICS.increment("disagg.pages_deduped", n_claim)
+            try:
+                ticket = self.decode.admit_from_pages(
+                    pending, pages=claimed + fresh, uploads=uploads,
+                    generation=gen)
+            except BaseException:
+                dead = pool.decref_quarantine(claimed + fresh)
+                self.decode.queue_wipe(dead)
+                raise
+            return ticket, plan
+
+    # ------------------------------------------------------------ wire form
+    @staticmethod
+    def export_payload(src, record: PrefillRecord,
+                       cached_len: int = 0) -> dict:
+        """Serialize ``record`` for a cross-process migration
+        (``POST /v1/migrate``): the request, the per-page content
+        hashes, and base64 page bytes for content pages beyond
+        ``cached_len`` positions (a prior probe of the decode side —
+        pass 0 to ship everything).  Consumes the record.
+
+        Wire shape: ``pages[str(i)]`` is the block-row-position-``i``
+        payload, a per-layer list of ``{name: {b64, dtype, shape}}`` —
+        exactly what :meth:`InferenceEngine.read_pages` produced, int8
+        scales riding beside their pages.
+        """
+        ps = src.cfg.page_size
+        prompt = record.prompt
+        n_content, n_total = _page_counts(len(prompt),
+                                          record.max_new_tokens, ps)
+        skip = min(cached_len // ps, n_content)
+        keys = src.page_pool.chain_keys(prompt, len(prompt) - 1)
+        pages: dict[str, list] = {}
+        if skip < n_content:
+            raw = src.read_pages(record.pages[skip:n_content])
+            for j in range(n_content - skip):
+                pages[str(skip + j)] = [
+                    {name: _encode_arr(arr[j])
+                     for name, arr in layer.items()}
+                    for layer in raw]
+        src.release_prefill(record)
+        return {
+            "request": {
+                "prompt": list(prompt),
+                "max_new_tokens": record.max_new_tokens,
+                "temperature": record.temperature,
+                "seed": record.seed,
+                "eos_id": record.eos_id,
+            },
+            "page_size": ps,
+            "hashes": keys,
+            "pages": pages,
+        }
+
+    def import_payload(self, payload: dict) -> PendingResult:
+        """Import a wire-form migration: plan against the local pool,
+        claim what is resident, upload the provided bytes for the rest,
+        and queue the request for admission.  Raises ``RuntimeError``
+        (HTTP 409) when a needed content page has neither resident
+        bytes nor wire bytes — the exporter probed a prefix that has
+        since been evicted; it must re-export with full bytes.  Returns
+        the pending handle (``result()`` blocks until decode
+        completes)."""
+        req_d = payload["request"]
+        prompt = [int(t) for t in req_d["prompt"]]
+        max_new = int(req_d["max_new_tokens"])
+        ps = int(payload.get("page_size") or
+                 self.decode.page_pool.page_size)
+        if ps != self.decode.page_pool.page_size:
+            raise ValueError(
+                f"page_size mismatch: exporter {ps}, decode side "
+                f"{self.decode.page_pool.page_size} — migration requires "
+                "identical page geometry")
+        pool = self.decode.page_pool
+        n_content, n_total = _page_counts(len(prompt), max_new, ps)
+        wire = payload.get("pages") or {}
+        gen = int(self.decode.stats()["generation"])
+        claimed: list[int] = []
+        fresh: list[int] = []
+        try:
+            claimed, _cached = pool.lookup_prefix(prompt, len(prompt) - 1)
+            n_claim = len(claimed)
+            for i in range(n_claim, n_content):
+                if str(i) not in wire:
+                    raise RuntimeError(
+                        f"migration payload missing bytes for content "
+                        f"page {i} (claimed {n_claim} resident) — the "
+                        "probed prefix was evicted; re-export with full "
+                        "bytes")
+            fresh = pool.alloc(n_total - n_claim)
+            uploads = []
+            for j, i in enumerate(range(n_claim, n_content)):
+                layers = [{name: _decode_arr(enc)
+                           for name, enc in layer.items()}
+                          for layer in wire[str(i)]]
+                uploads.append((fresh[j], layers))
+        except BaseException:
+            dead = pool.decref_quarantine(claimed + fresh)
+            self.decode.queue_wipe(dead)
+            raise
+        req = GenerateRequest(
+            prompt=prompt, max_new_tokens=max_new,
+            temperature=float(req_d.get("temperature") or 0.0),
+            seed=int(req_d.get("seed") or 0),
+            eos_id=req_d.get("eos_id"))
+        req.submitted_s = time.monotonic()
+        pending = PendingResult(req)
+        METRICS.increment("disagg.migrations")
+        METRICS.increment("disagg.pages_moved", len(uploads))
+        METRICS.increment("disagg.pages_deduped", n_claim)
+        try:
+            ticket = self.decode.admit_from_pages(
+                pending, pages=claimed + fresh, uploads=uploads,
+                generation=gen)
+        except BaseException:
+            dead = pool.decref_quarantine(claimed + fresh)
+            self.decode.queue_wipe(dead)
+            raise
+        if not ticket.wait(timeout=60.0):
+            # pages already released by the drain fence; the request
+            # was never admitted — single-shot HTTP semantics say 409
+            if not pending.done():
+                pending._fail(MigrationRejected(ticket.reason or
+                                                "migration rejected"))
+            raise RuntimeError(
+                f"migration rejected at admission: {ticket.reason} — "
+                "safe to retry")
+        return pending
+
+
+def _encode_arr(arr) -> dict:
+    a = np.ascontiguousarray(arr)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _decode_arr(enc: dict):
+    raw = base64.b64decode(enc["b64"])
+    return np.frombuffer(raw, dtype=np.dtype(enc["dtype"])).reshape(
+        enc["shape"])
+
+
+#: module-level alias — the server's export path reads better without
+#: instantiating a migrator it has no decode engine for
+export_payload = KVMigrator.export_payload

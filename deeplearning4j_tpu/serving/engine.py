@@ -115,6 +115,16 @@ class ServingConfig:
     #                                 jax builds that have it (gated off by
     #                                 default like every quant tier).
     #                                 Requires paged=True.
+    # ---- disaggregated prefill/decode tier (DESIGN.md §27)
+    role: str = "unified"           # "unified" (classic colocated engine),
+    #                                 "prefill" (prompt prefill only: no
+    #                                 serve thread, work arrives through
+    #                                 prefill() and leaves as KV pages), or
+    #                                 "decode" (a unified engine that also
+    #                                 publishes the decode-tier queue gauge
+    #                                 and is the admit_from_pages target).
+    #                                 "prefill" requires paged=True — the
+    #                                 migration unit is a KV page.
 
 
 def kv_page_bytes(mcfg, page_size: int, kv_quant: str | None = None) -> int:
@@ -130,6 +140,77 @@ def kv_page_bytes(mcfg, page_size: int, kv_quant: str | None = None) -> int:
     if kv_quant is not None:
         per_layer += 2 * kvh * 4   # k_scale + v_scale rows, f32
     return per_layer * mcfg.n_layers
+
+
+class MigrationRejected(RuntimeError):
+    """A migrated request could not be admitted into the decode batch
+    (weight generation moved between claim and admission, engine
+    stopping).  Nothing was corrupted — the decode-side refcounts were
+    released and the request should simply be requeued and re-migrated
+    (the :class:`~.disagg.DisaggScheduler` does exactly that)."""
+
+
+@dataclasses.dataclass
+class PrefillRecord:
+    """The atomic migration handoff unit :meth:`InferenceEngine.prefill`
+    returns: the request's filled KV pages (block-table order, ONE
+    refcount per page owned by this record) plus everything the decode
+    side needs to continue the request token-identically.  Ownership is
+    linear — exactly one of :meth:`InferenceEngine.release_prefill` or
+    the KVMigrator's export seam consumes it."""
+
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    eos_id: int | None
+    pages: list[int]        # block-table order; record owns one ref each
+    cached_len: int         # positions aliased from the prefill-side cache
+    generation: int         # prefill-engine weight generation of the pages
+
+
+class MigrationTicket:
+    """Accept/reject signal for one :meth:`admit_from_pages` handoff.
+
+    The serve thread resolves it at the drain fence — accepted means the
+    engine now owns the pages and the request WILL decode (its
+    completion arrives through the pending handle); rejected means the
+    refcounts were already released and the caller should requeue."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._accepted = False          # write-once before _ev.set()
+        self._reason: str | None = None
+
+    def _resolve(self, accepted: bool, reason: str | None = None) -> None:
+        self._accepted = accepted
+        self._reason = reason
+        self._ev.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """True = admitted, False = rejected (see :attr:`reason`)."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError("migration ticket unresolved — is the "
+                               "decode engine's serve loop running?")
+        return self._accepted
+
+    @property
+    def reason(self) -> str | None:
+        return self._reason
+
+
+@dataclasses.dataclass
+class _MigratedIn:
+    """One migrated request parked for the serve thread's drain fence.
+    ``pages`` arrive already increfed on THIS engine's pool (claim +
+    alloc happened in the KVMigrator); ownership passes to the engine
+    the moment the record enters ``_migrated_in``."""
+
+    pending: PendingResult
+    pages: list[int]                  # block-table order, decode-side ids
+    uploads: list                     # [(page_id, [{name: ndarray}, ...])]
+    generation: int | None            # decode generation the claim assumed
+    ticket: MigrationTicket
 
 
 @dataclasses.dataclass
@@ -169,6 +250,12 @@ class InferenceEngine:
         if cfg.prefix_cache and not cfg.paged:
             raise ValueError("prefix_cache requires paged=True (sharing is "
                              "block-table aliasing)")
+        if cfg.role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role must be unified/prefill/decode, "
+                             f"got {cfg.role!r}")
+        if cfg.role == "prefill" and not cfg.paged:
+            raise ValueError("role='prefill' requires paged=True — the "
+                             "migration unit is a KV page")
         if cfg.kv_quant is not None:
             if not cfg.paged:
                 raise ValueError("kv_quant requires paged=True (scales live "
@@ -199,7 +286,14 @@ class InferenceEngine:
                       if cfg.paged else None)
         self._page_bytes = kv_page_bytes(model.cfg, cfg.page_size,
                                          cfg.kv_quant)
-        self._queue = RequestQueue(cfg.max_queue, cfg.max_batch_delay_ms)
+        # per-tier queue depth: the autoscaler distinguishes prefill
+        # pressure (bursty, compute-bound) from decode pressure (steady,
+        # memory-bound) by gauge name; unified keeps the classic name
+        self._queue = RequestQueue(
+            cfg.max_queue, cfg.max_batch_delay_ms,
+            depth_gauge={"prefill": "serving.queue.depth.prefill",
+                         "decode": "serving.queue.depth.decode"}.get(
+                             cfg.role, "serving.queue.depth"))
         self._ckpt: CheckpointManager | None = None
         self._loaded_step: int | None = None
         if checkpoint is not None:
@@ -268,6 +362,18 @@ class InferenceEngine:
         # pages quarantined by an off-thread clear_prefix (reload): the
         # serve thread wipes them at its next fence, then requeues them
         self._pending_wipe: list[int] = []           # guarded-by: self._lock
+        # ---- disagg tier (DESIGN.md §27) ----
+        # serializes prefill()/release_prefill()/read_pages(): on a
+        # prefill-role engine (no serve thread) _state is owned by
+        # whichever worker holds this lock
+        self._prefill_lock = threading.Lock()
+        # migrated requests parked for the serve thread's drain fence
+        self._migrated_in: list[_MigratedIn] = []    # guarded-by: self._lock
+        # lazily compiled draft-only prefill per bucket (speculative
+        # decode engines rebuild the migrated request's draft cache row
+        # locally — draft state never crosses the wire, and it only ever
+        # decides accept LENGTH, never which tokens emit)
+        self._draft_prefill_fns: dict[int, Callable] = {}  # guarded-by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._admitted = 0                           # guarded-by: self._lock
@@ -670,6 +776,11 @@ class InferenceEngine:
             return self
         if warmup:
             self.warmup()
+        if self.cfg.role == "prefill":
+            # prefill tier: no decode loop to run — work arrives through
+            # prefill() on the scheduler's worker threads, and _state
+            # stays owned by whoever holds _prefill_lock
+            return self
         self._stop.clear()
         self._thread = threading.Thread(target=self._serve_loop, daemon=True,
                                         name="serving-engine")
@@ -690,21 +801,30 @@ class InferenceEngine:
             # range — a dead slot's id must not leak out of the pool
             self._free = list(range(self.cfg.slots))
             pending, self._pending_wipe = self._pending_wipe, []
+            migrated, self._migrated_in = self._migrated_in, []
         for sl in dead.values():
             sl.pending._fail(
                 RuntimeError("engine stopped with request in flight"))
+        for rec in migrated:
+            # reject, never corrupt: the pages are decreffed below and
+            # the scheduler requeues on MigrationRejected
+            rec.ticket._resolve(False, "engine stopped")
+            rec.pending._fail(MigrationRejected(
+                "engine stopped before migrated request was admitted"))
         # the serve thread is joined, so _state is safe to touch here.
         # Reset the dead rows the way _evict would have — deactivate,
         # release K/V and (paged) park the block tables on the trash
         # page — so a restarted decode loop, which writes EVERY row's
         # K/V through its table, can never scribble on pages the pool
         # reallocates to new requests.
-        if dead or pending:
+        if dead or pending or migrated:
             with allow_transfers():
                 if self.cfg.paged:
                     freed = list(pending)
                     for pg in pages.values():
                         freed.extend(self._pool.decref(pg))
+                    for rec in migrated:
+                        freed.extend(self._pool.decref(rec.pages))
                     bt = self._state["bt"]
                     active = self._state["active"]
                     for s in dead:
@@ -778,7 +898,13 @@ class InferenceEngine:
                 dparams = self._draft_params if self.cfg.speculative else {}
                 # cost capture lowers with the concrete args BEFORE the
                 # donating call (lowering reads avals only, never buffers)
-                if self.cfg.speculative:
+                if self.cfg.role == "prefill":
+                    # a prefill-role engine never runs the decode step —
+                    # skipping its compile makes prefill-tier spin-up
+                    # (and the autoscaler's scale-up path) proportionally
+                    # cheaper; the bucket ladder below is the whole job
+                    state = self._state
+                elif self.cfg.speculative:
                     self._decode_cost = COSTS.capture(
                         "serving.decode_step", self._step_fn,
                         self._params, dparams, self._state, jnp.int32(0))
@@ -846,8 +972,14 @@ class InferenceEngine:
                     # pool.reset() below rebuilds the free list wholesale,
                     # so quarantined page ids would go stale — drop them
                     self._pending_wipe.clear()
+                    migrated, self._migrated_in = self._migrated_in, []
                 for sl in dead:
                     sl.pending._fail(e)
+                for rec in migrated:
+                    # pool.reset() reclaims their pages wholesale below
+                    rec.ticket._resolve(False, "serve loop crashed")
+                    rec.pending._fail(MigrationRejected(
+                        "serve loop crashed before admission"))
                 if self._pool is not None:
                     self._pool.reset()
                 with allow_transfers():
@@ -876,6 +1008,10 @@ class InferenceEngine:
             staged = self._staged is not None
         if applied:
             self._publish_generation_gauges()
+        if not staged:
+            # migrated requests enter the continuous batch HERE, between
+            # decode segments — the admit_from_pages seam (DESIGN.md §27)
+            self._drain_migrated()
         idle = not self._slots
         n_free = len(self._free)
         if n_free and not staged:
@@ -1024,6 +1160,355 @@ class InferenceEngine:
                 self._admitted += 1
             METRICS.increment("serving.admitted")
             self._publish_kv_gauges()
+
+    # ------------------------------------------- disagg tier (DESIGN.md §27)
+    @property
+    def page_pool(self) -> PagePool | None:
+        """The host-side page pool (None on dense engines).  The decode
+        half of a migration claims and allocates against it — but only
+        through the KVMigrator's export/import seams (graftlint DG01)."""
+        return self._pool
+
+    def prefill(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+                seed: int = 0, eos_id: int | None = None) -> PrefillRecord:
+        """Prefill-ONLY admission (the prefill tier's entire job): fill
+        the request's KV pages through the SAME compiled admit path a
+        colocated request uses — numerics cannot diverge — then release
+        the slot without decoding a single token.  Returns a
+        :class:`PrefillRecord` owning one refcount per page: the atomic
+        handoff unit the KVMigrator exports to a decode engine.
+
+        Requires a paged engine with NO serve thread running (a
+        ``role='prefill'`` engine never starts one): ``_state`` is owned
+        by whichever worker holds ``_prefill_lock``.
+        """
+        if not self.cfg.paged:
+            raise ValueError("prefill-only requires paged=True — the "
+                             "migration unit is a KV page")
+        if self._thread is not None:
+            raise RuntimeError("prefill() needs exclusive ownership of the "
+                               "device state — stop the serve loop first "
+                               "(role='prefill' engines never start one)")
+        cfg = self.model.cfg
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < cfg.vocab_size for t in prompt):
+            raise ValueError(f"prompt token out of range [0, {cfg.vocab_size})")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_len ({cfg.max_len})")
+        with self._prefill_lock, allow_transfers(), \
+                METRICS.time("serving.prefill_only"):
+            with self._lock:
+                # a prefill-role engine has no serve loop to reach the
+                # all-slots-free fence — prefill entry IS that fence
+                applied = self._try_apply_staged_locked()
+            if applied:
+                self._publish_generation_gauges()
+            self._drain_pending_wipe()
+            with self._lock:
+                if not self._free:
+                    raise QueueFull("no free slot for prefill")
+                slot = self._free.pop()
+                params = self._params
+                gen = self._generation
+            acquired: list[int] = []
+            try:
+                cached_len = 0
+                usable = len(prompt) - 1
+                if self.cfg.prefix_cache:
+                    # atomic with a params/generation re-capture, exactly
+                    # like _admit: an aliased prefix can never mix
+                    # weights with the prefill that extends it
+                    with self._lock:
+                        params = self._params
+                        gen = self._generation
+                        shared, cached_len = self._pool.lookup_prefix(
+                            prompt, usable)
+                    acquired.extend(shared)
+                need = -(-(len(prompt) + max_new_tokens) // self._page_size)
+                acquired.extend(self._pool.alloc(need - len(acquired)))
+                row = acquired + [self._num_pages] * (
+                    self._pages_per_slot - len(acquired))
+                # graftlint: disable=LK01 — _state is prefill-lock-owned:
+                # role='prefill' engines never start a serve thread, and
+                # _prefill_lock serializes every prefill worker
+                self._state = dict(
+                    self._state,
+                    bt=self._state["bt"].at[slot].set(
+                        jnp.asarray(row, jnp.int32)))
+                bucket = self._prompt_bucket(len(prompt))
+                padded = np.zeros((bucket,), np.int32)
+                padded[:len(prompt)] = prompt
+                admit_fn = self._admit_for(bucket)
+                dparams = self._draft_params if self.cfg.speculative else {}
+                self._state = admit_fn(
+                    params, dparams, self._state, jnp.asarray(padded),
+                    jnp.int32(len(prompt)), jnp.int32(cached_len),
+                    jnp.int32(slot), jax.random.key(int(seed)),
+                    jnp.float32(temperature), jnp.int32(max_new_tokens))
+                if self.cfg.prefix_cache:
+                    with self._lock:
+                        if self._params is params:
+                            self._pool.insert_prefix(prompt, acquired,
+                                                     usable)
+                    if cached_len:
+                        METRICS.increment("serving.prefix_hits")
+            except Exception:
+                if acquired:
+                    self._wipe_pages(self._pool.decref(acquired))
+                self._state = dict(
+                    self._state,
+                    bt=self._state["bt"].at[slot].set(self._num_pages))
+                with self._lock:
+                    self._free.append(slot)
+                raise
+            # release the slot WITHOUT decoding: deactivate the row and
+            # park its block table back on the trash page.  The pages
+            # stay pinned by the record's refcounts — that handoff (not
+            # the slot) is what migrates
+            self._state = dict(
+                self._state,
+                active=self._state["active"].at[slot].set(False),
+                bt=self._state["bt"].at[slot].set(self._num_pages))
+            with self._lock:
+                self._free.append(slot)
+            METRICS.increment("serving.prefills")
+            return PrefillRecord(
+                prompt=prompt, max_new_tokens=int(max_new_tokens),
+                temperature=float(temperature), seed=int(seed),
+                eos_id=(eos_id if eos_id is not None
+                        else self.cfg.default_eos_id),
+                pages=acquired, cached_len=cached_len, generation=gen)
+
+    def release_prefill(self, record: PrefillRecord) -> None:
+        """Consume a :class:`PrefillRecord` without migrating it (abort
+        path, chaos-killed worker): decref its pages and wipe the ones
+        that died.  Safe only where :meth:`prefill` is safe — no serve
+        thread owns ``_state``."""
+        if not record.pages:
+            return
+        with self._prefill_lock, allow_transfers():
+            pages, record.pages = record.pages, []
+            self._wipe_pages(self._pool.decref(pages))
+
+    def read_pages(self, ids) -> list[dict]:
+        """Host copies of the given physical pages: one dict per layer
+        mapping the pool's array names (``k``/``v``, plus
+        ``k_scale``/``v_scale`` under kv_quant) to an ``(n, ...)``
+        ndarray — a migration export's byte payload.  int8/GQA layouts
+        ride through verbatim: whatever the pool stores is what moves,
+        so the decode-side scatter is byte-identical."""
+        if not self.cfg.paged:
+            raise ValueError("read_pages needs a paged engine")
+        with self._prefill_lock, allow_transfers():
+            idx = jnp.asarray(list(ids), jnp.int32)
+            return [{name: np.asarray(arr[idx])
+                     for name, arr in layer.items()}
+                    for layer in self._state["pages"]]
+
+    def queue_wipe(self, pages: list[int]) -> None:
+        """Hand quarantined pages (refcount already zero, off the free
+        list) to the serve thread for zeroing — the migration-abort
+        release: the KVMigrator cannot touch device state it does not
+        own, and a page must never become allocatable before the serve
+        thread wipes it (wipe-before-reallocatable, DESIGN.md §17)."""
+        if not pages:
+            return
+        with self._lock:
+            self._pending_wipe.extend(pages)
+        self._queue.wake()
+
+    def admit_from_pages(self, pending: PendingResult, *, pages: list[int],
+                         uploads: list,
+                         generation: int | None = None) -> MigrationTicket:
+        """Queue a migrated request for admission into the continuous
+        batch — the serve thread installs it between decode segments
+        (:meth:`_drain_migrated`), so a migration never stalls in-flight
+        decode slots.
+
+        ``pages`` (block-table order) must already hold one refcount
+        each on THIS engine's pool — the KVMigrator's hash-only claims
+        plus its fresh allocations.  Ownership transfers to the engine
+        atomically with the queue append: whatever happens next (admit,
+        generation-mismatch reject, stop, crash) the engine releases
+        them exactly once.  ``uploads`` carries device bytes only for
+        pages that were actually moved; deduped pages are already
+        resident.  Returns a :class:`MigrationTicket` resolved at the
+        drain fence."""
+        if not self.cfg.paged:
+            raise ValueError("admit_from_pages needs a paged engine")
+        if self.cfg.role == "prefill":
+            raise ValueError("a prefill-role engine cannot decode")
+        req: GenerateRequest = pending.request
+        need = -(-(len(req.prompt) + req.max_new_tokens) // self._page_size)
+        if len(pages) != need or need > self._pages_per_slot:
+            raise ValueError(
+                f"page count {len(pages)} does not cover prompt+budget "
+                f"(need {need}, pages_per_slot {self._pages_per_slot})")
+        ticket = MigrationTicket()
+        with self._lock:
+            self._migrated_in.append(_MigratedIn(
+                pending=pending, pages=list(pages), uploads=list(uploads),
+                generation=generation, ticket=ticket))
+        self._queue.wake()   # break the serve loop's idle wait
+        return ticket
+
+    def _drain_migrated(self) -> None:
+        """Serve-thread drain of :meth:`admit_from_pages` records: one
+        free slot per record, between decode segments.  A record whose
+        claim generation no longer matches (a reload applied since the
+        KVMigrator planned the transfer) is REJECTED — pages released,
+        ticket failed — because its deduped pages hold old-generation
+        K/V; the scheduler requeues and re-migrates under the new
+        weights.  Reject, never corrupt."""
+        while True:
+            with self._lock:
+                if not self._migrated_in or not self._free \
+                        or self._staged is not None:
+                    return
+                rec = self._migrated_in.pop(0)
+                slot = self._free.pop()
+                gen, lstep = self._generation, self._loaded_step
+            with allow_transfers(), trace.span("serving.admit_migrated"):
+                ok = (rec.generation is None or rec.generation == gen) \
+                    and not rec.pending.done()
+                if not ok:
+                    # REJECT, do not fail: the pending handle stays open
+                    # so the migrator can re-plan under the new weights
+                    # and hand the same request back — the caller only
+                    # ever sees a completion or a terminal failure
+                    self._wipe_pages(self._pool.decref(rec.pages))
+                    with self._lock:
+                        self._free.append(slot)
+                    rec.ticket._resolve(
+                        False, "request done" if rec.pending.done()
+                        else "weight generation moved since migration plan")
+                    continue
+                try:
+                    self._admit_migrated(rec, slot)
+                except Exception as e:
+                    self._wipe_pages(self._pool.decref(rec.pages))
+                    self._state = dict(
+                        self._state,
+                        bt=self._state["bt"].at[slot].set(self._num_pages),
+                        active=self._state["active"].at[slot].set(False))
+                    with self._lock:
+                        self._free.append(slot)
+                    rec.ticket._resolve(False, str(e))
+                    rec.pending._fail(e)
+                    METRICS.increment("serving.engine.errors")
+                    continue
+                with self._lock:
+                    self._slots[slot] = _Slot(
+                        pending=rec.pending, admitted_s=time.monotonic(),
+                        generation=gen, loaded_step=lstep)
+                    self._slot_pages[slot] = rec.pages
+                    self._admitted += 1
+                rec.ticket._resolve(True)
+                METRICS.increment("serving.admitted")
+                self._publish_kv_gauges()
+
+    def _admit_migrated(self, rec: _MigratedIn, slot: int) -> None:
+        """Install a migrated request into ``slot``: upload the moved
+        page bytes (deduped pages are already resident — that is the
+        point), point the block-table row at the pages, and write the
+        same host-side admission state the compiled admit fn would have
+        produced — WITHOUT re-running prefill FLOPs (the jitted admit
+        recomputes the whole prompt; skipping that is migration's win).
+        The RNG key is seeded exactly as colocated admission seeds it,
+        so the decode draw stream is token-identical.  Speculative
+        engines additionally rebuild the slot's draft cache with a
+        draft-only prefill: draft-sized cost, parity-neutral (the draft
+        only ever decides accept length, never which tokens emit)."""
+        cfg = self.model.cfg
+        req: GenerateRequest = rec.pending.request
+        p_len = len(req.prompt)
+        st = self._state
+        if rec.uploads:
+            ids = jnp.asarray([pid for pid, _ in rec.uploads], jnp.int32)
+            new_pages = []
+            for li, layer in enumerate(st["pages"]):
+                upd = {}
+                for name, arr in layer.items():
+                    vals = np.stack([u[1][li][name] for u in rec.uploads])
+                    upd[name] = arr.at[ids].set(
+                        jnp.asarray(vals).astype(arr.dtype))
+                new_pages.append(upd)
+            st = dict(st, pages=new_pages)
+        row = rec.pages + [self._num_pages] * (
+            self._pages_per_slot - len(rec.pages))
+        padded = np.zeros((cfg.max_len,), np.int32)
+        padded[:p_len] = req.prompt
+        kd = jax.random.key_data(st["keys"]).at[slot].set(
+            jax.random.key_data(jax.random.key(req.seed)))
+        self._state = dict(
+            st,
+            bt=st["bt"].at[slot].set(jnp.asarray(row, jnp.int32)),
+            toks=st["toks"].at[slot].set(jnp.asarray(padded)),
+            # identical to compiled admission: prefill covered positions
+            # [0, p_len-1); the first decode step consumes token p_len-1
+            pos=st["pos"].at[slot].set(p_len - 1),
+            limit=st["limit"].at[slot].set(p_len - 1 + req.max_new_tokens),
+            temp=st["temp"].at[slot].set(float(req.temperature)),
+            keys=jax.random.wrap_key_data(kd),
+            active=st["active"].at[slot].set(True))
+        if self.cfg.speculative:
+            bucket = self._prompt_bucket(p_len)
+            pad_b = np.zeros((bucket,), np.int32)
+            pad_b[:p_len] = req.prompt
+            draft_fn = self._draft_prefill_for(bucket)
+            self._state = dict(
+                self._state,
+                draft_cache=draft_fn(self._draft_params,
+                                     self._state["draft_cache"],
+                                     jnp.asarray(pad_b), jnp.int32(p_len),
+                                     jnp.int32(slot)))
+        if self.cfg.prefix_cache:
+            # publish the migrated prompt's chains on the DECODE pool —
+            # the next migration of this prefix is a hash-only claim.
+            # Generation already matched at the drain fence, and a swap
+            # cannot apply while this slot is out of _free
+            with self._lock:
+                self._pool.insert_prefix(req.prompt, rec.pages, p_len - 1)
+
+    def _draft_prefill_for(self, bucket: int) -> Callable:
+        """Draft-ONLY prefill for one bucket (speculative migrated
+        admission): the target pages arrived by migration, but the draft
+        cache is local state — rebuild just it, at draft-model cost."""
+        with self._lock:
+            cached = self._draft_prefill_fns.get(bucket)
+        if cached is not None:
+            return cached
+        dcfg = self._draft_model.cfg
+
+        def draft_admit(dparams, dcache, prompt, p_len, slot):
+            dc1 = init_decode_cache(dcfg, 1)
+            last = jnp.maximum(p_len - 2, 0)
+
+            def body(i, dc):
+                ii = jnp.minimum(i, last)
+                tok_i = lax.dynamic_slice(prompt, (ii,), (1,))
+                _, dc_new = decode_step(dparams, dc, tok_i, ii, dcfg)
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(i < p_len - 1, a, b), dc_new, dc)
+
+            dc1 = lax.fori_loop(0, bucket, body, dc1)
+            return [
+                {"k": lax.dynamic_update_slice_in_dim(c["k"], c1["k"],
+                                                      slot, axis=0),
+                 "v": lax.dynamic_update_slice_in_dim(c["v"], c1["v"],
+                                                      slot, axis=0)}
+                for c, c1 in zip(dcache, dc1)]
+
+        draft_fn = jax.jit(draft_admit, donate_argnums=(1,))
+        with self._lock:
+            self._draft_prefill_fns[bucket] = draft_fn
+        return draft_fn
 
     def _publish_kv_gauges(self) -> None:
         """Device-KV footprint gauges at admission/eviction fences: pages
@@ -1346,6 +1831,7 @@ class InferenceEngine:
                 "prefill_buckets": sorted(self._admit_fns),
                 "running": self._thread is not None,
                 "warmed": self._warmed,
+                "role": self.cfg.role,
                 "speculative_enabled": (self.cfg.speculative
                                         and self._spec_enabled),
                 "max_new_cap": self._max_new_cap,

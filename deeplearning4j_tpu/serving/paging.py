@@ -128,6 +128,23 @@ class PagePool:
                 self._ref[p] += 1
             return list(best.pages), len(best.pages) * self.page_size
 
+    def peek_prefix(self, tokens: list[int], usable: int) -> int:
+        """Read-only variant of :meth:`lookup_prefix`: how many leading
+        positions are cached RIGHT NOW, with no incref and no LRU touch.
+        A migration PROBE uses this to plan its transfer schedule (which
+        pages to ship) without pinning anything; the answer is advisory
+        — the import claim re-walks the chain and may find more or fewer
+        pages, which the protocol handles with a re-plan, never a leak."""
+        keys = self.chain_keys(tokens, usable)
+        with self._lock:
+            depth = 0
+            for key in keys:
+                entry = self._prefix.get(key)
+                if entry is None:
+                    break
+                depth = len(entry.pages)
+            return depth * self.page_size
+
     def alloc(self, n: int) -> list[int]:
         """Take ``n`` fresh pages (refcount 1 each), LRU-evicting unpinned
         prefix entries as needed; raises :class:`PagePoolExhausted` when
@@ -189,6 +206,17 @@ class PagePool:
                 freed.append(p)
         return freed
 
+    def decref_quarantine(self, pages: list[int]) -> list[int]:
+        """Like :meth:`decref`, but pages whose count reaches zero are
+        QUARANTINED (off the books, NOT reallocatable) instead of freed
+        — the caller owns wiping them on device and handing them back
+        through :meth:`requeue`.  This is the migration abort/handoff
+        release: the KVMigrator runs off the serve thread, so it cannot
+        wipe, and a page must never become allocatable before the serve
+        thread has zeroed it (wipe-before-reallocatable)."""
+        with self._lock:
+            return self._decref_locked(pages, quarantine=True)
+
     def clear_prefix(self) -> list[int]:
         """Drop EVERY prefix entry — hot-reload invalidation: cached
         chains hold K/V computed under superseded weights, and a request
@@ -247,6 +275,13 @@ class PagePool:
     def prefix_entries(self) -> int:
         with self._lock:
             return len(self._prefix)
+
+    def refcounts(self) -> list[int]:
+        """Snapshot of every page's refcount — leak audits (a balanced
+        disagg migration must return the pool to its pre-migration
+        counts) without poking the private array per page."""
+        with self._lock:
+            return list(self._ref)
 
     def hit_rate(self) -> float:
         with self._lock:

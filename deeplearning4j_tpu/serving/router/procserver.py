@@ -34,9 +34,12 @@ def tiny_lm_factory(seed: int = 7, vocab_size: int = 64, d_model: int = 32,
                     n_heads: int = 4, n_layers: int = 2, d_ff: int = 64,
                     max_len: int = 64, slots: int = 4, resolve_every: int = 4,
                     max_queue: int = 64, paged: bool = False,
-                    page_size: int = 16, prefix_cache: bool = False):
+                    page_size: int = 16, prefix_cache: bool = False,
+                    role: str = "unified"):
     """The test-battery engine: a fixed-seed tiny transformer, identical
-    for identical kwargs in any process."""
+    for identical kwargs in any process.  ``role="prefill"`` spawns a
+    prefill-tier worker (paged forced on — the migration unit is a KV
+    page; no serve thread, ``/v1/generate`` refused by probes §27)."""
     import jax
     import jax.numpy as jnp
 
@@ -49,11 +52,14 @@ def tiny_lm_factory(seed: int = 7, vocab_size: int = 64, d_model: int = 32,
                             xent_chunk=0)
     model = TransformerLM(cfg)
     params = model.init(jax.random.key(seed))
+    if role == "prefill":
+        paged = True
     return InferenceEngine(
         model, params=params,
         cfg=ServingConfig(slots=slots, resolve_every=resolve_every,
                           max_queue=max_queue, paged=paged,
-                          page_size=page_size, prefix_cache=prefix_cache))
+                          page_size=page_size, prefix_cache=prefix_cache,
+                          role=role))
 
 
 def _resolve(spec: str):

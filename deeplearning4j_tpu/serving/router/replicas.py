@@ -503,9 +503,19 @@ class ReplicaPool:
             except (ServingRejected, ServingError, OSError) as e:
                 self.record_failure(name, f"probe: {e}")
                 continue
+            stats = health.get("engine") or {}
+            if stats.get("role") == "prefill":
+                # a prefill-role replica can never decode — routing it
+                # decode traffic would fail every request.  Role is in
+                # the health JSON precisely so this is verifiable over
+                # HTTP; treat it as a hard probe failure and let the
+                # breaker keep it out of the ring (DESIGN.md §27)
+                self.record_failure(
+                    name, "probe: prefill-role replica cannot serve "
+                          "decode traffic")
+                continue
             probe = {"time": time.time(), "health": health}
             self.record_success(name, probe=probe)
-            stats = health.get("engine") or {}
             qd = stats.get("queue_depth")
             if qd is not None:
                 METRICS.gauge(f"router.replica_queue_depth.{name}",

@@ -14,7 +14,15 @@ transport, not a new framework:
   → ``{"outputs": [[...], ...]}``
 - ``POST /v1/reload``    — hot swap to ``latest_valid_step()`` (or an
   explicit ``{"step": N}`` — the online loop's rollback path)
-- ``GET  /healthz``      — liveness + engine slot/queue stats
+- ``POST /v1/migrate``   — disagg KV-page import (DESIGN.md §27):
+  ``{"probe": {"prompt": [ids]}}`` → ``{"cached_len", "page_size"}``
+  (plan the export: resident positions need no bytes); a full payload
+  (``KVMigrator.export_payload``) installs the pages and blocks until
+  decode completes, answering like ``/v1/generate``.  A payload whose
+  probed prefix was evicted → 409 (re-export with full bytes)
+- ``GET  /healthz``      — liveness + engine slot/queue stats, plus
+  top-level ``role``/``warmed`` (the §27 probe contract: a prefill-role
+  replica is verifiably not a decode target over HTTP)
 - ``GET  /metrics``      — JSON registry snapshot
 - ``GET  /metrics.prom`` — Prometheus text exposition (scrape target)
 
@@ -55,6 +63,7 @@ class ModelServer:
         # generation — prompt, tokens, optional caller feedback, and the
         # weight generation the response decoded under
         self.capture = capture
+        self._migrator = None   # lazy KVMigrator for /v1/migrate imports
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -104,6 +113,8 @@ class ModelServer:
                             return self._json(200, outer._score(payload))
                         if self.path == "/v1/reload":
                             return self._json(200, outer._reload(payload))
+                        if self.path == "/v1/migrate":
+                            return self._json(200, outer._migrate(payload))
                     return self._json(404, {"error": f"no route {self.path}"})
                 except ServingRejected as e:
                     # backpressure IS the API: 429 queue-full, 504 deadline
@@ -178,10 +189,50 @@ class ModelServer:
         return {"step": self.engine.reload(
             step=int(step) if step is not None else None)}
 
+    def _migrate(self, p: dict) -> dict:
+        """Disagg KV-page import (DESIGN.md §27).  Probe mode plans the
+        export (how many positions are resident — those pages need no
+        bytes on the wire); import mode installs the pages through the
+        KVMigrator seam and blocks until decode completes, the wire
+        twin of ``/v1/generate``."""
+        if self.engine is None:
+            raise ValueError("no InferenceEngine mounted on this server")
+        # a DisaggScheduler fronts its decode engine; plain engines are
+        # their own migration target
+        target = getattr(self.engine, "decode", self.engine)
+        if getattr(target, "page_pool", None) is None:
+            raise ValueError("migration needs a paged engine "
+                             "(the migration unit is a KV page)")
+        probe = p.get("probe")
+        if probe is not None:
+            prompt = [int(t) for t in probe["prompt"]]
+            if not prompt:
+                raise ValueError("empty prompt")
+            return {"cached_len": target.page_pool.peek_prefix(
+                        prompt, len(prompt) - 1),
+                    "page_size": target.page_pool.page_size}
+        if "request" not in p:
+            raise ValueError("missing required field 'request'")
+        if self._migrator is None:
+            from .disagg.migrate import KVMigrator
+            self._migrator = KVMigrator(target)
+        pending = self._migrator.import_payload(p)
+        comp = pending.result(self.request_timeout_s)
+        return {"tokens": comp.tokens, "finish_reason": comp.finish_reason,
+                "latency_s": comp.latency_s, "ttft_s": comp.ttft_s,
+                "generation": comp.generation,
+                "loaded_step": comp.loaded_step}
+
     def _health(self) -> dict:
         out = {"ok": True}
         if self.engine is not None:
-            out["engine"] = self.engine.stats()
+            stats = self.engine.stats()
+            out["engine"] = stats
+            # top-level twins of the two fields the §27 probe contract
+            # depends on — verifiable over HTTP without knowing the
+            # stats schema
+            out["role"] = stats.get("role", "unified")
+            out["warmed"] = bool(stats.get("warmed"))
         if self.scorer is not None:
             out["scorer"] = {"queue_depth": self.scorer._queue.depth()}
         return out

@@ -22,6 +22,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# NOTE: do NOT point the persistent XLA compile cache (compile_cache.py)
+# at the suite — with this jaxlib (0.4.36) caching the 8-device sharded
+# trainer step segfaults the process (reproducer:
+# test_online_loop.py::test_round_trains_publishes_and_hot_reloads with
+# DL4J_TPU_COMPILE_CACHE_DIR set, even at min_compile_time_s=1.0).
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

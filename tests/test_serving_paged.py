@@ -64,11 +64,14 @@ def _expected(model, params, prompt, n, temp, seed):
 
 
 def _serve_plans(model, params, scfg, plans=PLANS, **engine_kw):
-    """Run ``plans`` through a fresh engine; returns the token lists."""
+    """Run ``plans`` through a fresh engine; returns the token lists.
+    Starts WITHOUT warmup: the plans touch only the 8/16 buckets, so the
+    cold-start ladder would compile graphs these tests never dispatch —
+    admission compiles the buckets it actually needs, tokens identical."""
     engine = InferenceEngine(model, params=params, cfg=scfg, **engine_kw)
     handles = [engine.submit(p, n, temperature=t, seed=s)
                for p, n, t, s in plans]
-    with engine:
+    with engine.start(warmup=False):
         return engine, [h.result(120.0).tokens for h in handles]
 
 

@@ -436,10 +436,10 @@ def test_nm01_clip_guard_quiets():
 
 # ------------------------------------------- registry / pragmas / baseline
 
-def test_registry_has_twenty_six_rules_incl_sharding_tier():
+def test_registry_has_twenty_seven_rules_incl_disagg_tier():
     rules = all_rules()
-    assert len(rules) == 26
-    for rid in ("SH01", "SH02", "SH03", "SH04", "NM01", "CT01"):
+    assert len(rules) == 27
+    for rid in ("SH01", "SH02", "SH03", "SH04", "NM01", "CT01", "DG01"):
         assert rid in rules
         assert rules[rid].title
 

@@ -17,6 +17,13 @@ subsystem: ``serving.request`` submission faults and ``serving.decode``
 dispatch skips, asserting completions stay token-identical to the
 fault-free ``Transformer.sample`` reference.
 
+A disagg leg (``run_disagg``, replay with ``--disagg --seed N``, part
+of the default composite) points the dice at the disaggregated tier
+(DESIGN.md §27): prefill workers killed before/after their prefill and
+migrations aborted with decode-side claims held, asserting every
+completion still matches the offline reference token-for-token, every
+abort requeued, and both pools' refcounts balance to zero leaked pages.
+
 A third leg (``run_elastic``, replay with ``--elastic --seed N``) rolls
 the elasticity dice: ``mesh.shrink`` kills 1-3 chips mid-run (sometimes
 handed back via ``mesh.grow``, sometimes with the resharding restore
@@ -338,6 +345,123 @@ def run_serving(seed: int, kv_quant: str | None = None) -> dict:
             f"{agreement:.4f} under the 0.999 floor")
     assert fired["serving.decode"] == decode_fires, result
     assert fired["serving.request"] == 1 and submit_faults == 1, result
+    assert not guard.violations(), guard.report()
+    return result
+
+
+def run_disagg(seed: int) -> dict:
+    """Chaos leg for the disaggregated tier (DESIGN.md §27): fire
+    ``disagg.prefill_worker`` (a prefill worker dies before or after its
+    prefill ran) and ``disagg.migrate`` (the page transfer aborts
+    mid-flight, decode-side claims already held) at random draw points
+    while a batch of requests streams through prefill + migration +
+    decode, and assert the tier's whole failure contract at once: every
+    completion is STILL token-identical to the fault-free
+    ``Transformer.sample`` reference (a killed migration only ever
+    REQUEUES — the single-shot completion can never carry tokens from a
+    half-migrated decode), the requeue counter saw every abort, and
+    after the dust settles both pools' refcounts balance — zero leaked
+    pages.  Runs under lockguard: the abort paths cross the pool,
+    engine and scheduler locks in exactly the orders easiest to get
+    wrong."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.analysis.lockguard import LockGuard
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.resilience import FaultSpec, inject_faults
+    from deeplearning4j_tpu.resilience.faults import FAULTS
+    from deeplearning4j_tpu.serving import DisaggScheduler, InferenceEngine, \
+        ServingConfig
+
+    rng = random.Random(seed + 6)
+    observability.enable()
+    METRICS.reset()
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(11))
+
+    def mk(role):
+        return InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=4, resolve_every=4, max_queue=64,
+                              paged=True, page_size=8, prefix_cache=True,
+                              role=role))
+
+    reqs = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                         for _ in range(rng.randint(2, 12))],
+                 max_new_tokens=rng.randint(1, 8),
+                 temperature=rng.choice([0.0, 0.8]),
+                 seed=rng.randrange(1 << 16))
+            for _ in range(6)]
+    expected = [model.sample(params, r["prompt"], r["max_new_tokens"],
+                             temperature=r["temperature"],
+                             key=jax.random.key(r["seed"]),
+                             kv_cache=True)[len(r["prompt"]):]
+                for r in reqs]
+
+    # the worker site fires twice per attempt (before and after the
+    # prefill), the migrate site twice per migration — draw the abort
+    # points so both "nothing acquired yet" and "claims held" unwind
+    # paths get exercised across seeds
+    worker_fires = rng.randint(1, 2)
+    specs = [FaultSpec("disagg.prefill_worker",
+                       at_step=rng.randint(1, 4), max_fires=worker_fires),
+             FaultSpec("disagg.migrate",
+                       at_step=rng.randint(1, 6), max_fires=1)]
+
+    guard = LockGuard().install()
+    pf = mk("prefill")
+    dec = mk("decode")
+    try:
+        with inject_faults(*specs, seed=seed):
+            sched = DisaggScheduler([pf], dec).start()
+            try:
+                pendings = [sched.submit(**r) for r in reqs]
+                outs = [p.result(120.0) for p in pendings]
+                fired = {
+                    s.site: FAULTS.fire_count(s.site) for s in specs}
+                time.sleep(0.3)      # let abandoned-ticket unwinds land
+                # zero-leak audit: drop the prefix-cache pins (the only
+                # legitimate surviving references) and every page must
+                # return to the free list with refcounts balanced
+                leaks = {}
+                for name, pool in (("prefill", pf.page_pool),
+                                   ("decode", dec.page_pool)):
+                    pool.requeue(pool.clear_prefix())
+                    leaks[name] = (pool.num_pages - pool.free_count(),
+                                   sum(pool.refcounts()))
+            finally:
+                sched.stop()
+    finally:
+        guard.uninstall()
+
+    requeues = METRICS.snapshot()["counters"].get("disagg.requeues", 0.0)
+    parity = all(o.tokens == e for o, e in zip(outs, expected))
+    result = {
+        "seed": seed,
+        "requests": len(reqs),
+        "token_parity_under_faults": parity,
+        "worker_faults_fired": fired["disagg.prefill_worker"],
+        "migrate_faults_fired": fired["disagg.migrate"],
+        "requeues": requeues,
+        "leaked_pages": leaks,
+        "lockguard_violations": len(guard.violations()),
+    }
+    assert parity, f"seed {seed}: migrated tokens diverged under injection"
+    total_fired = fired["disagg.prefill_worker"] + fired["disagg.migrate"]
+    assert total_fired >= 1, result
+    assert requeues >= total_fired, (
+        f"seed {seed}: {total_fired} aborts but only {requeues} requeues "
+        "— a killed migration was not requeued", result)
+    assert leaks == {"prefill": (0, 0), "decode": (0, 0)}, (
+        f"seed {seed}: leaked pages after chaos: {leaks}")
     assert not guard.violations(), guard.report()
     return result
 
@@ -966,6 +1090,10 @@ def _dispatch_legs(argv: list[str], seed, shardguard) -> int:
         # replay a single failing overload/brownout draw
         return finish(run_overload(seed if seed is not None
                                    else random.SystemRandom().randrange(2 ** 31)))
+    if "--disagg" in argv:
+        # replay a single failing disagg-migration draw
+        return finish(run_disagg(seed if seed is not None
+                                 else random.SystemRandom().randrange(2 ** 31)))
     if "--stage" in argv:
         # replay a single failing (seed, stage) draw
         stage = int(argv[argv.index("--stage") + 1])
@@ -980,6 +1108,7 @@ def _dispatch_legs(argv: list[str], seed, shardguard) -> int:
         stage: run(base + stage, zero_stage=stage) for stage in (1, 2, 3)}
     result["serving"] = run_serving(base)
     result["serving_kv_int8"] = run_serving(base, kv_quant="int8")
+    result["disagg"] = run_disagg(base)
     result["elastic"] = run_elastic(base)
     result["online"] = run_online(base)
     result["overload"] = run_overload(base)

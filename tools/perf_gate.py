@@ -62,6 +62,7 @@ SERIES: tuple[tuple[str, tuple[str, ...], str], ...] = (
      ("goodput.fraction", "goodput_fraction"), "higher"),
     ("fleet_scrape_ms", ("fleet.scrape_ms",), "lower"),
     ("replica_hours_saved_frac", ("autoscale.saved_frac",), "higher"),
+    ("disagg_dedup_frac", ("disagg.dedup_frac",), "higher"),
 )
 
 DIRECTIONS = {name: direction for name, _, direction in SERIES}

@@ -13,7 +13,14 @@ histograms land.
     python tools/serving_smoke.py [--requests 32] [--threads 4] [--seed 0]
                                   [--lockguard] [--prefix-workload]
                                   [--trace-out trace.json] [--slo] [--online]
-                                  [--autoscale]
+                                  [--autoscale] [--disagg]
+
+``--disagg`` switches to the disaggregated-tier leg (DESIGN.md §27): a
+bimodal workload where decode-heavy requests stream through the
+prefill tier + KV-page migration while prefill-heavy background load
+runs at 1x and then 2x.  FAILS unless every migrated decode matches
+the offline reference token-for-token and the decode stream's p99
+inter-token latency at 2x prefill load stays within 1.15x of baseline.
 
 ``--autoscale`` switches to the control-plane leg (DESIGN.md §26): an
 ``Autoscaler`` scales a live router pool 1 -> 2 -> 1 through the real
@@ -538,6 +545,186 @@ def run_prefix(requests: int = 32, threads: int = 4, seed: int = 0,
         assert agreement >= 0.999, (
             f"served-token top-1 agreement {agreement:.4f} under the "
             "0.999 floor")
+    return result
+
+
+def run_disagg(requests: int = 24, threads: int = 3, seed: int = 0,
+               lockguard: bool = False) -> dict:
+    """The ``--disagg`` leg (DESIGN.md §27): a bimodal workload against
+    the disaggregated prefill/decode tier.
+
+    Decode-heavy requests (short prompts, 16-token budgets) stream
+    while prefill-heavy background traffic (page-spanning prompts,
+    1-token budgets) runs at 1x and then at DOUBLE the load.  The run
+    FAILS unless (a) every decode answer matches ``Transformer.sample``
+    token-for-token — migration parity under load — and (b) the decode
+    stream's p99 inter-token latency at 2x prefill load stays within
+    1.15x of its 1x baseline: prefill pressure lands on the prefill
+    tier, not on the decode cadence.  The shared background prompts
+    also exercise the content-addressed dedup path; the emitted
+    ``{"disagg": {"dedup_frac": ...}}`` feeds ``perf_gate.py
+    --record``."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import observability
+    from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                       TransformerLM)
+    from deeplearning4j_tpu.observability import METRICS
+    from deeplearning4j_tpu.serving import (DisaggScheduler, InferenceEngine,
+                                            ServingConfig)
+
+    observability.enable()
+    METRICS.reset()
+
+    guard = None
+    if lockguard:
+        from deeplearning4j_tpu.analysis.lockguard import LockGuard
+
+        guard = LockGuard().install()
+
+    page_size = 8
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                            d_ff=64, max_len=64, dtype=jnp.float32,
+                            remat=False, xent_chunk=0)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.key(7))
+
+    def mk(role):
+        return InferenceEngine(
+            model, params=params,
+            cfg=ServingConfig(slots=4, resolve_every=4, max_queue=64,
+                              paged=True, page_size=page_size,
+                              prefix_cache=True, role=role))
+
+    rng = random.Random(seed)
+    # decode-heavy stream: short prompts, long budgets, greedy so every
+    # answer is checkable against the offline reference
+    dplans = [dict(prompt=[rng.randrange(cfg.vocab_size)
+                           for _ in range(rng.randint(4, 9))],
+                   max_new_tokens=16, temperature=0.0, seed=0)
+              for _ in range(requests)]
+    expected = [list(model.sample(params, p["prompt"], 16, temperature=0.0,
+                                  key=jax.random.key(0),
+                                  kv_cache=True))[len(p["prompt"]):]
+                for p in dplans]
+    # prefill-heavy background: a few shared page-spanning prompts
+    # (5 full pages), 1-token budgets — nearly all their cost is prefill
+    bg_prompts = [[rng.randrange(cfg.vocab_size)
+                   for _ in range(5 * page_size)] for _ in range(3)]
+
+    failures: list[str] = []
+    lock = threading.Lock()
+
+    pf = mk("prefill")
+    dec = mk("decode")
+    sched = DisaggScheduler([pf], dec).start()
+    try:
+        def phase(bg_threads: int, measure: bool) -> dict:
+            """Drive the decode stream while ``bg_threads`` background
+            loops hammer the prefill tier; per-request mean inter-token
+            seconds for the decode stream come back for the p99."""
+            stop = threading.Event()
+            itls: list[float] = []
+            bg_done = [0]
+
+            def bg_loop(k):
+                i = k
+                while not stop.is_set():
+                    try:
+                        sched.generate(bg_prompts[i % len(bg_prompts)], 1,
+                                       temperature=0.0, seed=0, timeout=120)
+                        with lock:
+                            bg_done[0] += 1
+                    except Exception as e:  # noqa: BLE001 - tallied
+                        with lock:
+                            failures.append(f"bg: {e}")
+                        return
+                    i += 1
+
+            def worker(mine):
+                for idx, plan in mine:
+                    try:
+                        c = sched.generate(**plan, timeout=120)
+                    except Exception as e:  # noqa: BLE001 - tallied
+                        with lock:
+                            failures.append(f"decode: {e}")
+                        continue
+                    if c.tokens != expected[idx]:
+                        with lock:
+                            failures.append(
+                                f"parity: plan {idx} {c.tokens} != "
+                                f"{expected[idx]}")
+                    if measure and len(c.tokens) > 1:
+                        with lock:
+                            itls.append((c.latency_s - c.ttft_s)
+                                        / (len(c.tokens) - 1))
+
+            bgs = [threading.Thread(target=bg_loop, args=(k,))
+                   for k in range(bg_threads)]
+            for t in bgs:
+                t.start()
+            numbered = list(enumerate(dplans))
+            ts = [threading.Thread(target=worker,
+                                   args=(numbered[i::threads],))
+                  for i in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            stop.set()
+            for t in bgs:
+                t.join()
+            itls.sort()
+            p99 = (itls[min(len(itls) - 1, int(0.99 * len(itls)))]
+                   if itls else None)
+            return {"itl_p99_s": p99, "bg_completed": bg_done[0]}
+
+        # warmup: touch every prompt bucket once so neither measured
+        # phase pays jit compilation inside its latency samples
+        phase(1, measure=False)
+        base = phase(1, measure=True)
+        doubled = phase(2, measure=True)
+    finally:
+        sched.stop()
+
+    if guard is not None:
+        guard.uninstall()
+        guard.emit_metrics()
+        for v in guard.violations():
+            failures.append(str(v))
+
+    snap = METRICS.snapshot()["counters"]
+    moved = snap.get("disagg.pages_moved", 0.0)
+    deduped = snap.get("disagg.pages_deduped", 0.0)
+    dedup_frac = deduped / max(1.0, moved + deduped)
+    ratio = (doubled["itl_p99_s"] / base["itl_p99_s"]
+             if base["itl_p99_s"] else None)
+    result = {
+        "workload": "disagg",
+        "requests": requests,
+        "threads": threads,
+        "seed": seed,
+        "page_size": page_size,
+        "itl_p99_base_s": base["itl_p99_s"],
+        "itl_p99_doubled_s": doubled["itl_p99_s"],
+        "itl_p99_ratio": round(ratio, 4) if ratio is not None else None,
+        "bg_completed": (base["bg_completed"], doubled["bg_completed"]),
+        "migrations": snap.get("disagg.migrations", 0.0),
+        "requeues": snap.get("disagg.requeues", 0.0),
+        "disagg": {"dedup_frac": round(dedup_frac, 4),
+                   "pages_moved": moved, "pages_deduped": deduped},
+        "failures": failures[:5],
+    }
+    if guard is not None:
+        result["lockguard_violations"] = len(guard.violations())
+    assert not failures, failures[:5]
+    assert doubled["bg_completed"] >= 2 * base["bg_completed"] * 0.5, (
+        "doubled phase did not actually raise prefill load", result)
+    assert deduped > 0, "shared background prompts never deduped a page"
+    assert ratio is not None and ratio <= 1.15, (
+        f"decode p99 inter-token degraded {ratio:.2f}x when prefill load "
+        f"doubled — the tiers are not isolated ({result})")
     return result
 
 
@@ -1471,6 +1658,13 @@ def main(argv: list[str]) -> int:
         out = run_autoscale(seed=arg("--seed", 0),
                             requests=arg("--requests", 24),
                             threads=arg("--threads", 4))
+        print(json.dumps(out))
+        return 0
+    if "--disagg" in argv:
+        out = run_disagg(requests=arg("--requests", 24),
+                         threads=arg("--threads", 3),
+                         seed=arg("--seed", 0),
+                         lockguard="--lockguard" in argv)
         print(json.dumps(out))
         return 0
     if "--fleet" in argv:
